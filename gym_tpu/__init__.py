@@ -1,0 +1,14 @@
+"""gym_tpu — TPU-native framework for simulated distributed training.
+
+Capability-parity rebuild of EXO Gym (see SURVEY.md): K simulated
+data-parallel nodes with pluggable synchronization strategies (AllReduce,
+FedAvg, DiLoCo, SPARTA, DeMo), implemented SPMD-first on a JAX device mesh
+instead of process-per-node message passing.
+"""
+
+from .trainer import FitResult, LocalTrainer, Trainer
+from .train_node import TrainState
+
+__version__ = "0.1.0"
+
+__all__ = ["Trainer", "LocalTrainer", "FitResult", "TrainState"]

@@ -1,0 +1,5 @@
+from .sampler import (ArrayDataset, IndexedDataset, NodeBatchIterator,
+                      as_dataset, resolve_node_datasets)
+
+__all__ = ["ArrayDataset", "IndexedDataset", "NodeBatchIterator",
+           "as_dataset", "resolve_node_datasets"]

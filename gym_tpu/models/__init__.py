@@ -1,0 +1,4 @@
+from .base import LossModel, as_loss_model
+from .mnist_cnn import CNN, MnistLossModel
+
+__all__ = ["LossModel", "as_loss_model", "CNN", "MnistLossModel"]

@@ -1,0 +1,91 @@
+"""Model contract: a module maps a raw data batch to scalar loss.
+
+Reference contract (``README.md:140-142``, ``exogym/train_node.py:163-165``):
+``loss = model(batch)``. Here the same contract over a Flax module:
+``module.apply(variables, batch, train=...)`` returns a scalar loss.
+``LossModel`` adapts it to pure functions over (params, model_state) where
+``model_state`` carries non-parameter collections (e.g. BatchNorm running
+stats — the reference CNN uses BatchNorm2d, ``example/mnist.py:37-51``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+class LossModel:
+    """Adapter: flax ``module(batch, train) -> loss`` as pure loss functions.
+
+    compute_dtype: when set (e.g. jnp.bfloat16) inputs/params are cast for
+    the forward pass — the analog of the reference's bf16 autocast
+    (``train_node.py:161-163``), TPU-native: bf16 feeds the MXU directly.
+    """
+
+    def __init__(self, module: nn.Module, compute_dtype: Optional[Any] = None):
+        self.module = module
+        self.compute_dtype = compute_dtype
+
+    def init(self, rng: jax.Array, example_batch: PyTree) -> Tuple[PyTree, PyTree]:
+        p_rng, d_rng = jax.random.split(rng)
+        variables = self.module.init(
+            {"params": p_rng, "dropout": d_rng}, example_batch, train=False
+        )
+        variables = dict(variables)
+        params = variables.pop("params")
+        return params, variables  # (params, model_state)
+
+    def loss(
+        self,
+        params: PyTree,
+        model_state: PyTree,
+        batch: PyTree,
+        rng: jax.Array,
+        train: bool,
+    ) -> Tuple[jnp.ndarray, PyTree]:
+        variables = {"params": params, **model_state}
+        if self.compute_dtype is not None:
+            variables = jax.tree.map(
+                lambda x: x.astype(self.compute_dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                variables,
+            )
+            batch = jax.tree.map(
+                lambda x: x.astype(self.compute_dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                batch,
+            )
+        if train and model_state:
+            loss, mutated = self.module.apply(
+                variables, batch, train=True,
+                rngs={"dropout": rng}, mutable=list(model_state.keys()),
+            )
+            if self.compute_dtype is not None:
+                mutated = jax.tree.map(
+                    lambda new, old: new.astype(old.dtype),
+                    dict(mutated), {k: model_state[k] for k in mutated},
+                )
+            new_state = {**model_state, **mutated}
+            return jnp.asarray(loss, jnp.float32), new_state
+        if train:
+            loss = self.module.apply(
+                variables, batch, train=True, rngs={"dropout": rng}
+            )
+        else:
+            loss = self.module.apply(variables, batch, train=False)
+        return jnp.asarray(loss, jnp.float32), model_state
+
+
+def as_loss_model(model) -> LossModel:
+    if isinstance(model, LossModel):
+        return model
+    if isinstance(model, nn.Module):
+        return LossModel(model)
+    raise TypeError(
+        f"model must be a flax Module or LossModel, got {type(model)}"
+    )

@@ -1,0 +1,54 @@
+"""MNIST CNN matching the reference example's architecture.
+
+Reference (``example/mnist.py:31-75``): two conv blocks
+(64→64 pool, 128→128 pool; 3×3 convs, BatchNorm, ReLU, spatial Dropout 0.25)
+then Flatten → Linear 256 → ReLU → Dropout 0.5 → Linear 10, wrapped so
+``forward(batch) -> cross_entropy``. TPU-native differences: NHWC layout
+(XLA's preferred conv layout) instead of torch NCHW; channel-wise
+``Dropout2d`` is Dropout with spatial broadcast dims.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+
+class CNN(nn.Module):
+    """Backbone producing 10 logits from [B, 28, 28, 1] images."""
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        def block(x, feat):
+            for _ in range(2):
+                x = nn.Conv(feat, (3, 3), padding="SAME")(x)
+                x = nn.BatchNorm(use_running_average=not train)(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            # torch Dropout2d zeroes whole channels: broadcast over H, W.
+            x = nn.Dropout(0.25, broadcast_dims=(1, 2),
+                           deterministic=not train)(x)
+            return x
+
+        x = block(x, 64)
+        x = block(x, 128)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(256)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(10)(x)
+
+
+class MnistLossModel(nn.Module):
+    """``forward(batch) -> loss`` wrapper (reference ``example/mnist.py:67-75``)."""
+
+    @nn.compact
+    def __call__(self, batch, train: bool = True):
+        imgs, labels = batch
+        if imgs.ndim == 4 and imgs.shape[1] == 1:  # accept NCHW input
+            imgs = jnp.transpose(imgs, (0, 2, 3, 1))
+        logits = CNN()(imgs, train=train)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), labels
+        ).mean()

@@ -1,0 +1,5 @@
+from .axis import AxisCtx, NODE_AXIS, VNODE_AXIS, single_node_ctx
+from .mesh import NodeRuntime
+
+__all__ = ["AxisCtx", "NodeRuntime", "NODE_AXIS", "VNODE_AXIS",
+           "single_node_ctx"]

@@ -1,0 +1,108 @@
+"""Node-axis collective context.
+
+The reference framework (EXO Gym) simulates K training nodes as K OS processes
+joined by a ``torch.distributed`` process group, and exposes ``broadcast`` /
+``all_reduce`` / ``all_gather`` free functions (reference:
+``exogym/strategy/communicate.py:63-75``). Here the K nodes are a *mesh axis*
+of one SPMD program: up to ``P`` physical devices carry the ``'node'`` mesh
+axis (via ``jax.shard_map``) and the remaining factor ``V = K / P`` is a
+vmapped ``'vnode'`` axis, so collectives over the pair ``('node', 'vnode')``
+span all K simulated nodes. XLA lowers these to ICI collectives on real
+multi-chip meshes; there is no rendezvous, no process group, and no barrier —
+lockstep is a property of the compiled program.
+
+``AxisCtx`` is the object strategies receive instead of ``(rank, num_nodes)``:
+it knows the axis names and node count, and provides the collective toolkit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PyTree = Any
+
+NODE_AXIS = "node"
+VNODE_AXIS = "vnode"
+
+
+@dataclasses.dataclass(frozen=True)
+class AxisCtx:
+    """Collective context for one simulated node inside the SPMD program.
+
+    Replaces the reference's ``(rank, num_nodes)`` pair plus the
+    ``communicate.py`` free functions. All methods must be called from inside
+    the node program (under ``shard_map`` + ``vmap``).
+    """
+
+    num_nodes: int
+    # Axis names spanning the simulated-node dimension, outermost first.
+    # ('node', 'vnode') in the standard runtime; a subset in tests.
+    axes: tuple = (NODE_AXIS, VNODE_AXIS)
+    # Size of each axis, same order as `axes`. prod(sizes) == num_nodes.
+    sizes: tuple = (1, 1)
+
+    # -- collectives ------------------------------------------------------
+
+    def psum(self, tree: PyTree) -> PyTree:
+        """Sum across all simulated nodes (reference all_reduce SUM)."""
+        if self.num_nodes == 1:
+            return tree
+        return jax.tree.map(lambda x: lax.psum(x, self.axes), tree)
+
+    def pmean(self, tree: PyTree) -> PyTree:
+        """Mean across all simulated nodes (all_reduce SUM then /K,
+        the idiom at e.g. reference ``exogym/strategy/diloco.py:34-37``)."""
+        if self.num_nodes == 1:
+            return tree
+        return jax.tree.map(lambda x: lax.pmean(x, self.axes), tree)
+
+    def all_gather(self, tree: PyTree) -> PyTree:
+        """Gather from all nodes: each leaf gains a leading axis of size K,
+        ordered by linear node index (reference ``all_gather`` tensor_list)."""
+        if self.num_nodes == 1:
+            return jax.tree.map(lambda x: x[None], tree)
+
+        def gather(x):
+            # Gather innermost-first so the final leading axis is ordered by
+            # the linear index produced by `node_index` (outer*inner + inner).
+            for ax in reversed(self.axes):
+                x = lax.all_gather(x, ax, tiled=False)
+            # x now has one leading axis per name; flatten them into one.
+            k = self.num_nodes
+            return x.reshape((k,) + x.shape[len(self.axes):])
+
+        return jax.tree.map(gather, tree)
+
+    def node_index(self) -> jnp.ndarray:
+        """Linear index of this simulated node in [0, K) (reference rank)."""
+        idx = jnp.zeros((), jnp.int32)
+        for name, size in zip(self.axes, self.sizes):
+            idx = idx * size + lax.axis_index(name)
+        return idx
+
+    def broadcast_from(self, tree: PyTree, src: int = 0) -> PyTree:
+        """Every node receives node `src`'s value (reference ``broadcast``).
+
+        In SPMD this is an all_gather + static index; strategies mostly don't
+        need it because rank-asymmetric computation is replaced by replicated
+        deterministic computation (see DiLoCo), but it is kept for parity and
+        for tests.
+        """
+        if self.num_nodes == 1:
+            return tree
+        gathered = self.all_gather(tree)
+        return jax.tree.map(lambda g: g[src], gathered)
+
+    def ppermute(self, tree: PyTree, perm: Sequence[tuple]) -> PyTree:
+        """Ring-style permute across the *outer* (physical) node axis only."""
+        return jax.tree.map(lambda x: lax.ppermute(x, self.axes[0], perm), tree)
+
+
+def single_node_ctx() -> AxisCtx:
+    """Ctx for K=1 (all collectives degenerate to identity)."""
+    return AxisCtx(num_nodes=1, axes=(), sizes=())

@@ -1,0 +1,144 @@
+"""Node-mesh runtime: K simulated nodes as one SPMD program.
+
+Replaces the reference's process-per-node orchestration
+(``exogym/trainer.py:221-228`` mp.spawn, ``trainer.py:310-351`` process-group
+rendezvous, ``train_node.py:618`` per-step barrier): here the K simulated
+nodes are the leading axis of every state array, sharded over up to P physical
+devices (mesh axis ``'node'``) with the remaining factor V = K/P vmapped
+(axis name ``'vnode'``). One ``jax.jit`` of a ``shard_map`` program *is* the
+cluster; collectives ride ICI on real multi-chip meshes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .axis import NODE_AXIS, VNODE_AXIS, AxisCtx
+
+PyTree = Any
+
+
+def _largest_divisor_at_most(n: int, cap: int) -> int:
+    for d in range(min(n, cap), 0, -1):
+        if n % d == 0:
+            return d
+    return 1
+
+
+@dataclasses.dataclass
+class NodeRuntime:
+    """Execution runtime for K simulated nodes on a set of real devices.
+
+    Every "global" array managed by the runtime has leading axis K
+    (one slice per simulated node), stored sharded: axis 0 is split into
+    [P, V] with P over the ``'node'`` mesh axis.
+    """
+
+    num_nodes: int
+    mesh: Mesh
+    n_phys: int   # P — physical devices carrying the 'node' mesh axis
+    n_virt: int   # V — simulated nodes folded per device (vmap)
+    ctx: AxisCtx
+
+    @classmethod
+    def create(cls, num_nodes: int, devices: Sequence[jax.Device] | None = None):
+        if devices is None:
+            devices = jax.devices()
+        n_phys = _largest_divisor_at_most(num_nodes, len(devices))
+        n_virt = num_nodes // n_phys
+        mesh = Mesh(np.asarray(devices[:n_phys]), (NODE_AXIS,))
+        ctx = AxisCtx(
+            num_nodes=num_nodes,
+            axes=(NODE_AXIS, VNODE_AXIS),
+            sizes=(n_phys, n_virt),
+        )
+        return cls(num_nodes=num_nodes, mesh=mesh, n_phys=n_phys,
+                   n_virt=n_virt, ctx=ctx)
+
+    # -- sharding helpers -------------------------------------------------
+
+    @property
+    def node_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(NODE_AXIS))
+
+    @property
+    def replicated_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def shard_batch(self, tree: PyTree) -> PyTree:
+        """Put host arrays with leading axis K onto the mesh, node-sharded."""
+        return jax.device_put(tree, self.node_sharding)
+
+    def to_host(self, tree: PyTree) -> PyTree:
+        return jax.device_get(tree)
+
+    # -- program compilation ---------------------------------------------
+
+    def compile(
+        self,
+        node_fn: Callable[..., Any],
+        *,
+        donate_state: bool = True,
+        n_state_args: int = 1,
+    ):
+        """Compile a per-node function into the K-node SPMD program.
+
+        ``node_fn(*args)`` sees the *single-node* view of each argument
+        (leading K axis stripped) and may use ``self.ctx`` collectives.
+        Returns a jitted function over global arrays with leading axis K.
+        """
+        ctx = self.ctx
+
+        def block_fn(*args):
+            return jax.vmap(node_fn, axis_name=VNODE_AXIS)(*args)
+
+        def program(*args):
+            n_in = len(args)
+            return jax.shard_map(
+                block_fn,
+                mesh=self.mesh,
+                in_specs=(P(NODE_AXIS),) * n_in,
+                out_specs=P(NODE_AXIS),
+                check_vma=False,
+            )(*args)
+
+        donate = tuple(range(n_state_args)) if donate_state else ()
+        return jax.jit(program, donate_argnums=donate)
+
+    def init_state(self, init_fn: Callable[[jnp.ndarray], PyTree]) -> PyTree:
+        """Build per-node initial state: ``init_fn(node_index) -> state``.
+
+        Parameters must be *identical* across nodes when ``init_fn`` ignores
+        asymmetry — this replaces the reference's initial parameter broadcast
+        from rank 0 (``exogym/train_node.py:101-104``): replicas constructed
+        from the same seed are identical by determinism, no collective needed.
+        """
+        ctx = self.ctx
+
+        def node_init(_):
+            return init_fn(ctx.node_index())
+
+        program = self.compile(node_init, donate_state=False)
+        dummy = self.shard_batch(np.zeros((self.num_nodes,), np.int32))
+        return program(dummy)
+
+    def unshard(self, tree: PyTree) -> PyTree:
+        """Host copy of a K-leading global pytree."""
+        return jax.device_get(tree)
+
+    def average_over_nodes(self, tree: PyTree) -> PyTree:
+        """Uniform average over the node axis (host-side), matching the
+        reference's final model averaging (``exogym/trainer.py:95-119``):
+        integer leaves are averaged in float and cast back."""
+        def avg(x):
+            x = np.asarray(x)
+            if np.issubdtype(x.dtype, np.integer) or x.dtype == np.bool_:
+                return x.astype(np.float64).mean(axis=0).astype(x.dtype)
+            return x.mean(axis=0)
+        return jax.tree.map(avg, jax.device_get(tree))

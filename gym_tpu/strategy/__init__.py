@@ -1,0 +1,18 @@
+"""Synchronization strategies (reference ``exogym/strategy/__init__.py``).
+
+Each strategy is a pure (init, step) pair over param pytrees; collectives
+run over the simulated-node mesh axes. Unlike the reference,
+``SimpleReduceStrategy`` is exported here too (it was missing from the
+reference's re-exports — SURVEY §2.1).
+"""
+
+from .base import Strategy
+from .optim import OptimSpec, ensure_optim_spec
+from .simple_reduce import SimpleReduceStrategy
+
+__all__ = [
+    "Strategy",
+    "OptimSpec",
+    "ensure_optim_spec",
+    "SimpleReduceStrategy",
+]

@@ -1,0 +1,146 @@
+"""Strategy base: "optimizer ∪ communication schedule" as a pure function.
+
+Reference (``exogym/strategy/strategy.py:18-63``): a Strategy owns the
+optimizer + scheduler and its ``step()`` performs *all* post-gradient work —
+clipping, communication, optimizer step. Here a Strategy is a pair of pure
+functions over pytrees:
+
+    state   = strategy.init(params)
+    params', state', metrics = strategy.step(grads, params, state, step, ctx)
+
+run inside the jitted SPMD node program; ``ctx`` (AxisCtx) supplies
+collectives over the simulated-node axis. ``finalize(max_steps)`` must be
+called before ``init`` — it builds the optax transforms and lr schedule (the
+reference equivalently injects ``strategy.max_steps`` before training at
+``train_node.py:583``).
+
+Communication volume is a first-class metric: every ``step`` returns
+``comm_bytes`` — the analytic per-node payload the algorithm would transmit
+on a real network (the reference only tracked this for DeMo and never logged
+it; SURVEY §5.5).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.axis import AxisCtx
+from .schedule import build_lr_scale
+
+PyTree = Any
+
+
+def tree_bytes(tree: PyTree) -> int:
+    """Total payload size of a pytree in bytes (static python int)."""
+    return int(
+        sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(tree))
+    )
+
+
+def tree_num_params(tree: PyTree) -> int:
+    return int(sum(x.size for x in jax.tree.leaves(tree)))
+
+
+def clip_by_global_norm(tree: PyTree, max_norm: float) -> PyTree:
+    """Global-norm gradient clipping (torch
+    ``nn_utils.clip_grad_norm_`` semantics, used at reference
+    ``strategy.py:135-138``)."""
+    sq = sum(jnp.sum(jnp.square(x)) for x in jax.tree.leaves(tree))
+    norm = jnp.sqrt(sq)
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree.map(lambda x: x * scale, tree)
+
+
+class Strategy(abc.ABC):
+    """Base strategy. Subclasses implement ``init`` and ``step``.
+
+    Constructor mirrors the reference's kwargs surface
+    (``lr_scheduler``, ``lr_scheduler_kwargs``, ``max_norm``) but unknown
+    kwargs are rejected by subclasses' explicit signatures rather than
+    silently setattr'd (kills the bug class of SURVEY §5.6).
+    """
+
+    def __init__(
+        self,
+        lr_scheduler: Optional[str] = None,
+        lr_scheduler_kwargs: Optional[dict] = None,
+        max_norm: Optional[float] = None,
+    ):
+        self.lr_scheduler = lr_scheduler
+        self.lr_scheduler_kwargs = lr_scheduler_kwargs
+        self.max_norm = max_norm
+        self.max_steps = 1
+        self._lr_scale = None
+        self._finalized = False
+
+    # -- lifecycle --------------------------------------------------------
+
+    def finalize(self, max_steps: int) -> "Strategy":
+        """Bind ``max_steps`` (needed by the lr schedule) and build
+        optimizers. Idempotent."""
+        self.max_steps = int(max_steps)
+        self._lr_scale = build_lr_scale(
+            self.lr_scheduler, self.lr_scheduler_kwargs, self.max_steps
+        )
+        self._build()
+        self._finalized = True
+        return self
+
+    def _build(self) -> None:
+        """Subclass hook: construct optax transforms using self._lr_scale."""
+
+    # -- pure API ---------------------------------------------------------
+
+    @abc.abstractmethod
+    def init(self, params: PyTree) -> PyTree:
+        """Per-node strategy state for `params` (single-node view)."""
+
+    @abc.abstractmethod
+    def step(
+        self,
+        grads: PyTree,
+        params: PyTree,
+        state: PyTree,
+        step: jnp.ndarray,
+        ctx: AxisCtx,
+    ) -> Tuple[PyTree, PyTree, Dict[str, jnp.ndarray]]:
+        """One post-gradient step: communicate + optimize.
+
+        Returns (new_params, new_state, metrics). ``metrics`` must include
+        ``comm_bytes`` (per-node bytes transmitted this step).
+        """
+
+    # -- logging helpers --------------------------------------------------
+
+    def lr_at(self, step: int) -> float:
+        """Host-side lr for logging (replaces the reference's lr_callbacks,
+        ``strategy.py:56-58``: the schedule is deterministic, so the logger
+        evaluates it instead of receiving callbacks)."""
+        base = getattr(self, "optim_spec", None)
+        base_lr = base.lr if base is not None else 0.0
+        if self._lr_scale is None:
+            return base_lr
+        return float(base_lr * self._lr_scale(jnp.asarray(step)))
+
+    def config(self) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {"strategy": type(self).__name__}
+        if self.lr_scheduler:
+            cfg["lr_scheduler"] = self.lr_scheduler
+            cfg.update(
+                {f"lr_{k}": v for k, v in (self.lr_scheduler_kwargs or {}).items()}
+            )
+        if self.max_norm is not None:
+            cfg["max_norm"] = self.max_norm
+        spec = getattr(self, "optim_spec", None)
+        if spec is not None:
+            cfg.update(spec.config())
+        return cfg
+
+    def _maybe_clip(self, grads: PyTree) -> PyTree:
+        if self.max_norm:
+            return clip_by_global_norm(grads, self.max_norm)
+        return grads

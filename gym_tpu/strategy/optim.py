@@ -1,0 +1,133 @@
+"""Declarative optimizer factory (reference ``exogym/strategy/optim.py``).
+
+The reference ``OptimSpec`` holds a torch optimizer class + kwargs and maps
+string names adam/adamw/sgd/rmsprop/adagrad (``optim.py:19-36``). Here the
+spec resolves to an ``optax.GradientTransformation``; torch-style kwarg names
+(``lr``, ``betas``, ``eps``, ``weight_decay``, ``momentum``, ``nesterov``) are
+accepted so reference configs port verbatim. A learning-rate *scale* schedule
+(see ``schedule.py``) multiplies the base lr.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Union
+
+import optax
+
+# torch defaults, per torch.optim docs (Adam/AdamW lr=1e-3, betas=(.9,.999),
+# eps=1e-8, AdamW weight_decay=1e-2; SGD momentum=0; RMSprop lr=1e-2,
+# alpha=0.99; Adagrad lr=1e-2).
+_TORCH_DEFAULTS = {
+    "adam": dict(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0),
+    "adamw": dict(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=1e-2),
+    "sgd": dict(lr=1e-3, momentum=0.0, nesterov=False, weight_decay=0.0),
+    "rmsprop": dict(lr=1e-2, alpha=0.99, eps=1e-8, momentum=0.0,
+                    weight_decay=0.0),
+    "adagrad": dict(lr=1e-2, eps=1e-10, weight_decay=0.0),
+}
+
+ScheduleFn = Callable[[Any], Any]  # step -> lr multiplier
+
+
+@dataclasses.dataclass
+class OptimSpec:
+    """Named optimizer + kwargs; ``build()`` returns an optax transform.
+
+    Mirrors reference ``OptimSpec`` (``exogym/strategy/optim.py:10-39``) but
+    is validated: unknown kwargs raise instead of being silently dropped
+    (the silent-kwarg bug class called out in SURVEY §5.6).
+    """
+
+    name: str = "adamw"
+    kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def __init__(self, name: str = "adamw", **kwargs: Any):
+        if callable(name):  # tolerate OptimSpec(optax.adamw, ...) style
+            name = getattr(name, "__name__", str(name))
+        name = str(name).lower()
+        if name not in _TORCH_DEFAULTS:
+            available = ", ".join(sorted(_TORCH_DEFAULTS))
+            raise ValueError(
+                f"Unknown optimizer '{name}'. Available options: {available}"
+            )
+        allowed = set(_TORCH_DEFAULTS[name]) | {"betas", "b1", "b2"}
+        unknown = set(kwargs) - allowed
+        if unknown:
+            raise ValueError(
+                f"Unknown kwargs for optimizer '{name}': {sorted(unknown)}"
+            )
+        self.name = name
+        self.kwargs = dict(kwargs)
+
+    @property
+    def lr(self) -> float:
+        return float(self.kwargs.get("lr", _TORCH_DEFAULTS[self.name]["lr"]))
+
+    def build(self, lr_scale: Optional[ScheduleFn] = None) -> optax.GradientTransformation:
+        cfg = {**_TORCH_DEFAULTS[self.name], **self.kwargs}
+        base_lr = float(cfg["lr"])
+        if lr_scale is None:
+            lr: Union[float, Callable] = base_lr
+        else:
+            lr = lambda step: base_lr * lr_scale(step)  # noqa: E731
+
+        if self.name in ("adam", "adamw"):
+            b1, b2 = cfg.get("betas", (0.9, 0.999))
+            b1 = cfg.get("b1", b1)
+            b2 = cfg.get("b2", b2)
+            wd = float(cfg["weight_decay"])
+            if self.name == "adam":
+                # torch Adam's weight_decay is L2 folded into the gradient
+                # *before* the moment updates — i.e. add_decayed_weights
+                # upstream of adam, not AdamW-style decoupled decay.
+                tx = optax.adam(lr, b1=b1, b2=b2, eps=cfg["eps"])
+                if wd:
+                    tx = optax.chain(optax.add_decayed_weights(wd), tx)
+                return tx
+            return optax.adamw(lr, b1=b1, b2=b2, eps=cfg["eps"],
+                               weight_decay=wd)
+        if self.name == "sgd":
+            mom = float(cfg["momentum"]) or None
+            tx = optax.sgd(lr, momentum=mom, nesterov=bool(cfg["nesterov"]))
+            if cfg["weight_decay"]:
+                tx = optax.chain(
+                    optax.add_decayed_weights(float(cfg["weight_decay"])), tx
+                )
+            return tx
+        if self.name == "rmsprop":
+            tx = optax.rmsprop(lr, decay=float(cfg["alpha"]), eps=cfg["eps"],
+                               momentum=float(cfg["momentum"]) or None)
+            if cfg["weight_decay"]:
+                tx = optax.chain(
+                    optax.add_decayed_weights(float(cfg["weight_decay"])), tx
+                )
+            return tx
+        if self.name == "adagrad":
+            tx = optax.adagrad(lr, eps=cfg["eps"])
+            if cfg["weight_decay"]:
+                tx = optax.chain(
+                    optax.add_decayed_weights(float(cfg["weight_decay"])), tx
+                )
+            return tx
+        raise AssertionError(self.name)
+
+    def config(self) -> Dict[str, Any]:
+        return {"optimizer": self.name, **self.kwargs}
+
+
+def ensure_optim_spec(
+    optim: Union[str, OptimSpec, None],
+    default: Optional[OptimSpec] = None,
+    **kwargs: Any,
+) -> OptimSpec:
+    """Coercion helper (reference ``optim.py:42-60``)."""
+    if optim is None:
+        return default if default is not None else OptimSpec("adamw", **kwargs)
+    if isinstance(optim, str):
+        return OptimSpec(optim, **kwargs)
+    if isinstance(optim, OptimSpec):
+        if kwargs:
+            return OptimSpec(optim.name, **{**optim.kwargs, **kwargs})
+        return optim
+    raise TypeError(f"Expected str, OptimSpec, or None, got {type(optim)}")

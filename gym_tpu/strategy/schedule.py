@@ -1,0 +1,66 @@
+"""Learning-rate scale schedules.
+
+Reference semantics (``exogym/strategy/strategy.py:65-95``): an LR *lambda*
+multiplying the optimizer's base lr — linear warmup over ``warmup_steps``,
+then either constant 1.0 or cosine anneal to a 0.1 floor over
+``max_steps``. ``max_steps`` may be capped by the scheduler kwargs.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def warmup_cosine_scale(
+    max_steps: int,
+    warmup_steps: int = 1,
+    cosine_anneal: bool = False,
+    min_lr_factor: float = 0.1,
+):
+    """Return ``scale(step) -> multiplier in (0, 1]``.
+
+    Matches reference ``lr_lambda`` exactly: warmup factor is
+    ``step / max(warmup_steps, 1)``; cosine term decays to
+    ``min_lr_factor``; without ``cosine_anneal`` the post-warmup factor
+    is 1.0 (``strategy.py:75-85``).
+    """
+    warmup_steps = int(warmup_steps)
+    max_steps = int(max_steps)
+
+    def scale(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = step / jnp.maximum(warmup_steps, 1)
+        if cosine_anneal:
+            progress = (step - warmup_steps) / max(
+                1, max_steps - warmup_steps
+            )
+            progress = jnp.clip(progress, 0.0, 1.0)
+            cosine = 0.5 * (1.0 + jnp.cos(jnp.pi * progress))
+            post = (1 - min_lr_factor) * cosine + min_lr_factor
+        else:
+            post = jnp.asarray(1.0, jnp.float32)
+        return jnp.where(step < warmup_steps, warm, post)
+
+    return scale
+
+
+def build_lr_scale(lr_scheduler, lr_scheduler_kwargs, max_steps: int):
+    """Resolve the strategy's scheduler config into a scale fn (or None).
+
+    ``lr_scheduler='lambda_cosine'`` is the only named scheduler in the
+    reference (``strategy.py:87-88``); kwargs: ``warmup_steps``,
+    ``cosine_anneal``, optional ``max_steps`` cap (``strategy.py:67-73``).
+    """
+    if lr_scheduler is None:
+        return None
+    if lr_scheduler != "lambda_cosine":
+        raise ValueError(
+            f"Unknown lr_scheduler {lr_scheduler!r}; expected 'lambda_cosine'"
+        )
+    kw = dict(lr_scheduler_kwargs or {})
+    capped = min(int(kw.get("max_steps", max_steps)), int(max_steps))
+    return warmup_cosine_scale(
+        max_steps=capped,
+        warmup_steps=int(kw.get("warmup_steps", 1)),
+        cosine_anneal=bool(kw.get("cosine_anneal", False)),
+    )

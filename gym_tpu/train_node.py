@@ -1,0 +1,135 @@
+"""Compiled per-node training/eval step builders.
+
+The reference's ``TrainNode`` (``exogym/train_node.py``) is a Python hot loop:
+grad-accum microbatches, grad rescale, ``strategy.step()``, per-step barrier.
+Here the whole per-step computation is one traced function compiled once over
+the node mesh; grad accumulation is a ``lax.scan`` over microbatches
+(keeps the MXU fed without re-tracing), and the barrier disappears — SPMD
+programs are lockstep by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from .models.base import LossModel
+from .parallel.axis import AxisCtx
+from .strategy.base import Strategy
+
+PyTree = Any
+
+
+@flax.struct.dataclass
+class TrainState:
+    params: PyTree
+    model_state: PyTree          # non-param collections (batch_stats, ...)
+    strategy_state: PyTree
+    step: jnp.ndarray            # int32 scalar
+    rng: jax.Array               # per-node PRNG key
+
+
+def make_init_fn(loss_model: LossModel, strategy: Strategy, example_micro,
+                 seed: int):
+    """Per-node state init. Params are built from the *same* seed on every
+    node — replicas start identical by determinism, replacing the reference's
+    initial broadcast from rank 0 (``train_node.py:101-104``). The dropout/
+    data RNG is folded with the node index so noise decorrelates across
+    nodes."""
+
+    def init_fn(node_index: jnp.ndarray) -> TrainState:
+        base = jax.random.PRNGKey(seed)
+        params, model_state = loss_model.init(base, example_micro)
+        return TrainState(
+            params=params,
+            model_state=model_state,
+            strategy_state=strategy.init(params),
+            step=jnp.zeros((), jnp.int32),
+            rng=jax.random.fold_in(base, node_index + 1),
+        )
+
+    return init_fn
+
+
+def make_train_step(loss_model: LossModel, strategy: Strategy, ctx: AxisCtx):
+    """Build ``node_step(state, batch) -> (state, metrics)``.
+
+    ``batch`` leaves are [n_micro, micro_bs, ...]; the scan accumulates
+    gradients and the sum is rescaled by n_micro, matching the reference's
+    grad-accumulation loop and rescale (``train_node.py:157-171``).
+    """
+
+    def node_step(state: TrainState, batch) -> Tuple[TrainState, Dict]:
+        step_rng = jax.random.fold_in(state.rng, state.step)
+        n_micro = jax.tree.leaves(batch)[0].shape[0]
+
+        grad_fn = jax.value_and_grad(loss_model.loss, has_aux=True)
+
+        def micro(carry, mb):
+            model_state, gsum, lsum, i = carry
+            (loss, new_ms), g = grad_fn(
+                state.params, model_state, mb,
+                jax.random.fold_in(step_rng, i), True,
+            )
+            gsum = jax.tree.map(jnp.add, gsum, g)
+            return (new_ms, gsum, lsum + loss, i + 1), None
+
+        gzero = jax.tree.map(jnp.zeros_like, state.params)
+        (model_state, gsum, lsum, _), _ = jax.lax.scan(
+            micro, (state.model_state, gzero, jnp.zeros(()), 0), batch
+        )
+        grads = jax.tree.map(lambda g: g / n_micro, gsum)
+        loss = lsum / n_micro
+
+        params, sstate, metrics = strategy.step(
+            grads, state.params, state.strategy_state, state.step, ctx
+        )
+        new_state = state.replace(
+            params=params,
+            model_state=model_state,
+            strategy_state=sstate,
+            step=state.step + 1,
+        )
+        metrics = dict(metrics)
+        metrics["loss"] = loss
+        return new_state, metrics
+
+    return node_step
+
+
+def make_eval_step(loss_model: LossModel, ctx: AxisCtx):
+    """Build ``node_eval(state, batch) -> (local_loss, global_loss)``.
+
+    Reference protocol (``train_node.py:181-246``): rank 0 evaluates its own
+    replica ("local"), rank 1 evaluates the node-averaged model ("global").
+    SPMD version: every node computes both — local loss of its own params and
+    loss of ``pmean(params)`` — on its own val stream; the trainer logs
+    local[0] and global[min(1, K-1)], preserving the reference's observable.
+    Buffers (batch_stats) stay local, as in the reference (only
+    ``named_parameters`` are all_reduced, ``train_node.py:187-189``).
+    """
+
+    def node_eval(state: TrainState, batch):
+        avg_params = ctx.pmean(state.params)
+        dummy_rng = jax.random.PRNGKey(0)
+
+        def body(carry, mb):
+            l_loc, l_glob = carry
+            loc, _ = loss_model.loss(
+                state.params, state.model_state, mb, dummy_rng, False
+            )
+            glob, _ = loss_model.loss(
+                avg_params, state.model_state, mb, dummy_rng, False
+            )
+            return (l_loc + loc, l_glob + glob), None
+
+        n = jax.tree.leaves(batch)[0].shape[0]
+        (l_loc, l_glob), _ = jax.lax.scan(
+            body, (jnp.zeros(()), jnp.zeros(())), batch
+        )
+        return l_loc / n, l_glob / n
+
+    return node_eval

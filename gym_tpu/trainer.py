@@ -1,0 +1,244 @@
+"""Trainer: the user-facing orchestration layer.
+
+API parity with the reference (``exogym/trainer.py:122-245``):
+``Trainer(model, train_dataset, val_dataset)`` then
+``.fit(num_epochs, strategy, num_nodes, ...)`` returns the node-averaged
+trained model state. Architectural difference (SURVEY §7): no process spawn,
+no rendezvous, no result queue — the K simulated nodes live on a device mesh
+inside one JIT-compiled program, so ``LocalTrainer`` is an alias kept for
+source compatibility.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from .data.sampler import NodeBatchIterator, resolve_node_datasets
+from .models.base import LossModel, as_loss_model
+from .parallel.mesh import NodeRuntime
+from .strategy.base import Strategy, tree_num_params
+from .train_node import make_eval_step, make_init_fn, make_train_step
+from .utils.logger import CSVLogger, Logger, WandbLogger
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class FitResult:
+    """What ``fit`` returns: averaged weights (the reference averages final
+    state dicts across ranks, ``trainer.py:236-243``) plus per-node state."""
+
+    params: PyTree                 # node-averaged params (host)
+    model_state: PyTree            # node-averaged non-param state (host)
+    node_state: Any                # final per-node TrainState (device)
+    steps: int
+    steps_per_second: float
+    final_train_loss: float
+    history: Dict[str, List]
+
+
+def _resolve_devices(device: Optional[str], devices: Optional[List[int]]):
+    if device is None:
+        devs = jax.devices()
+    else:
+        aliases = {"tpu": "tpu", "cpu": "cpu", "gpu": "gpu", "cuda": "gpu",
+                   "axon": None}
+        backend = aliases.get(device, device)
+        try:
+            devs = jax.devices(backend) if backend else jax.devices()
+        except RuntimeError:
+            devs = jax.devices()
+    if devices is not None:
+        devs = [devs[i] for i in devices]
+    return devs
+
+
+class Trainer:
+    def __init__(self, model, train_dataset, val_dataset=None, **kwargs):
+        self.model = model
+        self.train_dataset = train_dataset
+        self.val_dataset = val_dataset
+        self.kwargs = kwargs
+
+    def fit(
+        self,
+        num_epochs: int = 1,
+        strategy: Strategy = None,
+        num_nodes: int = 1,
+        max_steps: Optional[int] = None,
+        device: Optional[str] = None,
+        devices: Optional[List[int]] = None,
+        batch_size: int = 16,
+        minibatch_size: Optional[int] = None,
+        shuffle: bool = True,
+        val_size: int = 64,
+        val_interval: int = 100,
+        autocast: bool = False,
+        checkpoint_interval: Optional[int] = None,
+        save_dir: Optional[str] = None,
+        seed: int = 42,
+        wandb_project: Optional[str] = None,
+        run_name: Optional[str] = None,
+        log_dir: str = "logs",
+        show_progress: bool = True,
+        **extra,
+    ) -> FitResult:
+        assert strategy is not None, "fit requires a strategy"
+        if extra:
+            raise TypeError(f"Unknown fit() kwargs: {sorted(extra)}")
+        minibatch_size = minibatch_size or batch_size
+        assert batch_size % minibatch_size == 0, \
+            "batch_size must be a multiple of minibatch_size"
+        n_micro = batch_size // minibatch_size
+
+        loss_model = as_loss_model(self.model)
+        if autocast and loss_model.compute_dtype is None:
+            import jax.numpy as jnp
+            loss_model = LossModel(loss_model.module, jnp.bfloat16)
+
+        runtime = NodeRuntime.create(
+            num_nodes, _resolve_devices(device, devices)
+        )
+
+        train_dsets, train_sharded = resolve_node_datasets(
+            self.train_dataset, num_nodes, is_val=False
+        )
+        train_iter = NodeBatchIterator(
+            train_dsets, num_nodes, sharded=train_sharded,
+            shuffle=shuffle, seed=seed,
+        )
+        val_iter = None
+        if self.val_dataset is not None and val_size > 0:
+            val_dsets, val_sharded = resolve_node_datasets(
+                self.val_dataset, num_nodes, is_val=True
+            )
+            val_iter = NodeBatchIterator(
+                val_dsets, num_nodes, sharded=val_sharded,
+                shuffle=False, seed=seed,
+            )
+
+        # max_steps default: epochs × per-node samples / global batch
+        # (reference formula at train_node.py:576-581).
+        steps_per_epoch = max(1, train_iter.samples_per_node() // batch_size)
+        if max_steps is None:
+            max_steps = num_epochs * steps_per_epoch
+        strategy.finalize(max_steps)
+
+        # Example microbatch for shape-driven init.
+        ex = train_dsets[0].take(np.zeros(minibatch_size, dtype=np.int64))
+        example_micro = jax.tree.map(lambda a: a[:minibatch_size], ex)
+
+        init_fn = make_init_fn(loss_model, strategy, example_micro, seed)
+        state = runtime.init_state(init_fn)
+
+        train_step = runtime.compile(
+            make_train_step(loss_model, strategy, runtime.ctx)
+        )
+        eval_step = runtime.compile(
+            make_eval_step(loss_model, runtime.ctx), donate_state=False
+        )
+
+        config = {
+            "num_nodes": num_nodes, "batch_size": batch_size,
+            "minibatch_size": minibatch_size, "max_steps": max_steps,
+            "num_epochs": num_epochs, "seed": seed,
+            "autocast": autocast,
+            "model": type(loss_model.module).__name__,
+            "num_params": None,  # filled below
+            "mesh": {"physical": runtime.n_phys, "virtual": runtime.n_virt},
+            **strategy.config(),
+        }
+
+        if wandb_project:
+            logger: Logger = WandbLogger(
+                max_steps, wandb_project, run_name, config, show_progress
+            )
+        else:
+            logger = CSVLogger(
+                max_steps, run_name, log_dir, config, show_progress
+            )
+
+        history: Dict[str, List] = {
+            "train_loss": [], "local_loss": [], "global_loss": [],
+            "comm_bytes": [],
+        }
+
+        def run_eval():
+            if val_iter is None:
+                return
+            n_val_micro = max(1, val_size // minibatch_size)
+            vb = runtime.shard_batch(
+                val_iter.next_batch(n_val_micro, minibatch_size)
+            )
+            local, glob = eval_step(state, vb)
+            local = np.asarray(local)
+            glob = np.asarray(glob)
+            # Reference: "local" is rank 0's own replica, "global" is the
+            # averaged model evaluated on rank 1's stream
+            # (train_node.py:191-244).
+            logger.log_loss(float(local[0]), "local")
+            logger.log_loss(float(glob[min(1, num_nodes - 1)]), "global")
+            history["local_loss"].append((logger.step, float(local[0])))
+            history["global_loss"].append(
+                (logger.step, float(glob[min(1, num_nodes - 1)]))
+            )
+
+        pending = None  # (step_idx, metrics) — 1-step-lag fetch for overlap
+        t_start = time.time()
+        last_loss = float("nan")
+
+        def drain(p):
+            nonlocal last_loss
+            step_idx, m = p
+            loss = float(np.asarray(m["loss"])[0])
+            comm = float(np.asarray(m["comm_bytes"])[0])
+            last_loss = loss
+            lr = strategy.lr_at(step_idx)
+            logger.log_train(loss, lr, comm)
+            history["train_loss"].append((step_idx, loss))
+            history["comm_bytes"].append((step_idx, comm))
+
+        for step_idx in range(max_steps):
+            if val_interval and step_idx % val_interval == 0:
+                if pending is not None:
+                    drain(pending)
+                    pending = None
+                run_eval()
+            batch = runtime.shard_batch(
+                train_iter.next_batch(n_micro, minibatch_size)
+            )
+            state, metrics = train_step(state, batch)
+            if pending is not None:
+                drain(pending)
+            pending = (step_idx, metrics)
+            logger.increment_step()
+
+        if pending is not None:
+            drain(pending)
+        jax.block_until_ready(state.params)
+        elapsed = time.time() - t_start
+        run_eval()
+        logger.close()
+
+        avg_params = runtime.average_over_nodes(state.params)
+        avg_model_state = runtime.average_over_nodes(state.model_state)
+        return FitResult(
+            params=avg_params,
+            model_state=avg_model_state,
+            node_state=state,
+            steps=max_steps,
+            steps_per_second=max_steps / elapsed if elapsed > 0 else 0.0,
+            final_train_loss=last_loss,
+            history=history,
+        )
+
+
+# The reference distinguishes Trainer (abstract connection policy) from
+# LocalTrainer (localhost process group, ``trainer.py:310-351``). There is no
+# connection to build in SPMD — the alias keeps reference scripts working.
+LocalTrainer = Trainer

@@ -1,0 +1,3 @@
+from .logger import CSVLogger, Logger, WandbLogger
+
+__all__ = ["CSVLogger", "Logger", "WandbLogger"]

@@ -1,0 +1,179 @@
+"""Rank-0 observability: tqdm progress, CSV logs, optional wandb.
+
+Reference (``exogym/logger.py``): base Logger drives a tqdm bar with live
+loss/lr postfix; ``CSVLogger`` writes ``logs/<run>/train.csv``,
+``validation.csv``, ``config.json``; ``WandbLogger`` mirrors the same
+streams plus perplexity ``exp(loss)``. This port adds the metric the
+reference forgot to log: cumulative communicated bytes per node (SURVEY
+§5.5 — the whole point of these algorithms).
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import math
+import os
+import time
+from typing import Any, Dict, Optional
+
+try:
+    from tqdm import tqdm
+except ImportError:  # pragma: no cover
+    tqdm = None
+
+
+class Logger:
+    """Progress + train/val loss streams (reference ``logger.py:13-44``)."""
+
+    def __init__(self, max_steps: int, show_progress: bool = True):
+        self.max_steps = max_steps
+        self.step = 0
+        self.cum_comm_bytes = 0.0
+        self._t0 = time.time()
+        self.pbar = (
+            tqdm(total=max_steps, dynamic_ncols=True)
+            if (show_progress and tqdm is not None)
+            else None
+        )
+
+    def log_train(self, loss: float, lr: float = 0.0,
+                  comm_bytes: float = 0.0) -> None:
+        self.cum_comm_bytes += comm_bytes
+        if self.pbar is not None:
+            self.pbar.set_postfix(
+                loss=f"{loss:.4f}", lr=f"{lr:.1e}",
+                comm=_fmt_bytes(self.cum_comm_bytes),
+            )
+
+    def log_loss(self, loss: float, name: str) -> None:
+        if self.pbar is not None:
+            self.pbar.write(
+                f"step {self.step}: {name} loss {loss:.4f} "
+                f"(ppl {math.exp(min(loss, 20.0)):.2f})"
+            )
+
+    def increment_step(self) -> None:
+        self.step += 1
+        if self.pbar is not None:
+            self.pbar.update(1)
+
+    def close(self) -> None:
+        if self.pbar is not None:
+            self.pbar.close()
+
+    @property
+    def steps_per_second(self) -> float:
+        dt = time.time() - self._t0
+        return self.step / dt if dt > 0 else 0.0
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024.0:
+            return f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n:.1f}PB"
+
+
+class CSVLogger(Logger):
+    """``logs/<run>/{train.csv,validation.csv,config.json}``
+    (reference ``logger.py:134-201``)."""
+
+    def __init__(self, max_steps: int, run_name: Optional[str] = None,
+                 log_dir: str = "logs", config: Optional[Dict] = None,
+                 show_progress: bool = True):
+        super().__init__(max_steps, show_progress)
+        run_name = run_name or f"run_{int(time.time())}"
+        self.run_dir = os.path.join(log_dir, run_name)
+        os.makedirs(self.run_dir, exist_ok=True)
+        if config is not None:
+            with open(os.path.join(self.run_dir, "config.json"), "w") as f:
+                json.dump(_jsonable(config), f, indent=2, default=str)
+        self._train_f = open(os.path.join(self.run_dir, "train.csv"), "w",
+                             newline="")
+        self._train_w = csv.writer(self._train_f)
+        self._train_w.writerow(["step", "loss", "lr", "comm_bytes",
+                                "cum_comm_bytes"])
+        self._val_f = open(os.path.join(self.run_dir, "validation.csv"), "w",
+                           newline="")
+        self._val_w = csv.writer(self._val_f)
+        self._val_w.writerow(["step", "name", "loss", "perplexity"])
+
+    def log_train(self, loss, lr=0.0, comm_bytes=0.0):
+        super().log_train(loss, lr, comm_bytes)
+        self._train_w.writerow(
+            [self.step, f"{loss:.6f}", f"{lr:.8f}", f"{comm_bytes:.0f}",
+             f"{self.cum_comm_bytes:.0f}"]
+        )
+
+    def log_loss(self, loss, name):
+        super().log_loss(loss, name)
+        self._val_w.writerow(
+            [self.step, name, f"{loss:.6f}",
+             f"{math.exp(min(loss, 20.0)):.4f}"]
+        )
+        self._val_f.flush()
+
+    def close(self):
+        super().close()
+        self._train_f.close()
+        self._val_f.close()
+
+
+class WandbLogger(Logger):
+    """wandb mirror of the CSV streams (reference ``logger.py:47-131``).
+    Degrades to base Logger when wandb is unavailable/offline."""
+
+    def __init__(self, max_steps: int, project: str,
+                 run_name: Optional[str] = None,
+                 config: Optional[Dict] = None, show_progress: bool = True):
+        super().__init__(max_steps, show_progress)
+        try:
+            import wandb
+            self._wandb = wandb
+            self._run = wandb.init(project=project, name=run_name,
+                                   config=_jsonable(config or {}))
+        except Exception:
+            self._wandb = None
+            self._run = None
+
+    def log_train(self, loss, lr=0.0, comm_bytes=0.0):
+        super().log_train(loss, lr, comm_bytes)
+        if self._run is not None:
+            self._run.log(
+                {"train/loss": loss,
+                 "train/perplexity": math.exp(min(loss, 20.0)),
+                 "lr": lr, "comm/bytes_step": comm_bytes,
+                 "comm/bytes_cum": self.cum_comm_bytes},
+                step=self.step,
+            )
+
+    def log_loss(self, loss, name):
+        super().log_loss(loss, name)
+        if self._run is not None:
+            self._run.log(
+                {f"{name}/loss": loss,
+                 f"{name}/perplexity": math.exp(min(loss, 20.0))},
+                step=self.step,
+            )
+
+    def close(self):
+        super().close()
+        if self._run is not None:
+            self._run.finish()
+
+
+def _jsonable(obj: Any, depth: int = 0) -> Any:
+    """Best-effort config serializer (reference ``utils.py:17-99``
+    extract_config: depth-guarded, non-serializable values stringified)."""
+    if depth > 10:
+        return str(obj)
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v, depth + 1) for k, v in
+                list(obj.items())[:50]}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v, depth + 1) for v in obj[:10]]
+    return str(obj)

@@ -1,0 +1,34 @@
+"""Test harness: 8 virtual CPU devices — the JAX analog of the reference's
+"multi-node on one box" (mp.spawn + Gloo over localhost, SURVEY §4).
+
+Must run before any JAX backend initialization. The environment's
+sitecustomize registers an 'axon' TPU backend and forces
+``jax_platforms='axon,cpu'``; we override back to cpu for tests.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count=8 "
+    + os.environ.get("XLA_FLAGS", "")
+)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, f"expected 8 cpu devices, got {len(devs)}"
+    return devs[:8]
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)
