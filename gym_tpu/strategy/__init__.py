@@ -7,12 +7,33 @@ reference's re-exports — SURVEY §2.1).
 """
 
 from .base import Strategy
+from .communicate_optimize import (CommunicateOptimizeStrategy,
+                                   CommunicationModule)
+from .diloco import DiLoCoCommunicator, DiLoCoStrategy
+from .fedavg import AveragingCommunicator, FedAvgStrategy
 from .optim import OptimSpec, ensure_optim_spec
 from .simple_reduce import SimpleReduceStrategy
+from .sparta import (IndexSelector, PartitionedIndexSelector,
+                     RandomIndexSelector, ShuffledSequentialIndexSelector,
+                     SparseCommunicator, SPARTAStrategy)
+from .sparta_diloco import SPARTADiLoCoStrategy
 
 __all__ = [
     "Strategy",
     "OptimSpec",
     "ensure_optim_spec",
     "SimpleReduceStrategy",
+    "CommunicateOptimizeStrategy",
+    "CommunicationModule",
+    "DiLoCoStrategy",
+    "DiLoCoCommunicator",
+    "FedAvgStrategy",
+    "AveragingCommunicator",
+    "SPARTAStrategy",
+    "SparseCommunicator",
+    "IndexSelector",
+    "RandomIndexSelector",
+    "ShuffledSequentialIndexSelector",
+    "PartitionedIndexSelector",
+    "SPARTADiLoCoStrategy",
 ]

@@ -1,0 +1,115 @@
+"""DiLoCo: two-level optimization (inner per-step, outer Nesterov every H).
+
+Reference (``exogym/strategy/diloco.py``): inner AdamW every step; every H
+steps all nodes average params, rank 0 keeps a CPU ``master_model``, sets the
+outer pseudo-gradient ``master − averaged``, steps an outer
+SGD(lr=0.7, nesterov, momentum=0.9) (``:26-28``, ``:62-71``), then broadcasts
+the result from rank 0 (``:73-74``).
+
+TPU-native restatement (SURVEY §7 "hard parts"): there is no cheap
+"only rank 0 computes" in SPMD — instead the outer optimizer state (master
+params + momentum) is *replicated* and the outer step is computed identically
+on every node. The input is the psum-average (bitwise deterministic on TPU),
+so replicas remain bit-identical and the reference's rank-0 broadcast
+disappears — saving one full model broadcast per outer round
+(comm: 2(K−1)/K·|θ| per H steps vs the reference's allreduce+broadcast).
+
+``DiLoCoCommunicator`` is the communication-module form — the missing piece
+that makes the SPARTA×DiLoCo combo real (the reference imports a nonexistent
+``DiLoCoCommunicator``, ``sparta_diloco.py:6``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .base import PyTree, tree_bytes
+from .communicate_optimize import (CommunicateOptimizeStrategy,
+                                   CommunicationModule)
+from .optim import OptimSpec, ensure_optim_spec
+
+
+class DiLoCoCommunicator(CommunicationModule):
+    """Outer-loop model averaging + replicated Nesterov outer step."""
+
+    def __init__(
+        self,
+        H: int = 100,
+        outer_optim_spec: Optional[Union[str, OptimSpec]] = None,
+    ):
+        self.H = int(H)
+        self.outer_optim_spec = ensure_optim_spec(
+            outer_optim_spec,
+            OptimSpec("sgd", lr=0.7, nesterov=True, momentum=0.9),
+        )
+        self.outer_tx = self.outer_optim_spec.build()
+
+    def init(self, params: PyTree) -> PyTree:
+        return {
+            "master": jax.tree.map(jnp.array, params),
+            "outer_opt": self.outer_tx.init(params),
+        }
+
+    def communicate(self, params, mstate, step, ctx):
+        k = ctx.num_nodes
+        psize = float(tree_bytes(params))
+
+        def outer(params, mstate):
+            avg = ctx.pmean(params)
+            master = mstate["master"]
+            # outer pseudo-gradient: master − averaged (reference :43-45)
+            pseudo = jax.tree.map(jnp.subtract, master, avg)
+            updates, outer_opt = self.outer_tx.update(
+                pseudo, mstate["outer_opt"], master
+            )
+            master = optax.apply_updates(master, updates)
+            # all nodes sync to the new master (reference :47-49, :73-74 —
+            # but without the broadcast: the computation is replicated)
+            comm = jnp.asarray(2.0 * (k - 1) / max(k, 1) * psize)
+            return master, {"master": master, "outer_opt": outer_opt}, comm
+
+        def skip(params, mstate):
+            return params, mstate, jnp.zeros(())
+
+        do = jnp.logical_and(step % self.H == 0, step > 0)
+        return jax.lax.cond(do, outer, skip, params, mstate)
+
+    def config(self):
+        return {"module": "DiLoCoCommunicator", "H": self.H,
+                "outer_optimizer": self.outer_optim_spec.name,
+                "outer_lr": self.outer_optim_spec.lr}
+
+
+class DiLoCoStrategy(CommunicateOptimizeStrategy):
+    """Inner optimizer (default AdamW) + DiLoCo outer loop
+    (reference ``diloco.py:14-89``; ``optim_spec`` names the inner optimizer
+    for consistency with the reference signature)."""
+
+    def __init__(
+        self,
+        optim_spec: Optional[Union[str, OptimSpec]] = None,
+        outer_optim_spec: Optional[Union[str, OptimSpec]] = None,
+        H: int = 100,
+        max_norm: Optional[float] = None,
+        lr_scheduler=None,
+        lr_scheduler_kwargs=None,
+    ):
+        self.H = int(H)
+        super().__init__(
+            communication_modules=[
+                DiLoCoCommunicator(H=H, outer_optim_spec=outer_optim_spec)
+            ],
+            inner_optim=ensure_optim_spec(optim_spec, OptimSpec("adamw")),
+            max_norm=max_norm,
+            lr_scheduler=lr_scheduler,
+            lr_scheduler_kwargs=lr_scheduler_kwargs,
+        )
+
+    def config(self):
+        cfg = super().config()
+        cfg["H"] = self.H
+        return cfg

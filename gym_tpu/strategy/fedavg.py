@@ -1,16 +1,16 @@
 """FedAvg / local-SGD with optional random islands.
 
 Reference (``exogym/strategy/federated_averaging.py``): every H steps
-(H defaults to 1; gate ``local_step % H == 0 and local_step > 0`` at
-``:108-111``) node parameters are averaged — full-world via allreduce/N
-(``:56-59``) or, when ``island_size < num_nodes``, rank 0 shuffles the rank
-list, broadcasts it, ranks are partitioned into islands of ``island_size``
-and each island partial-averages via all_gather + subset mean (``:26-69``).
+(gate ``local_step % H == 0 and local_step > 0`` at ``:108-111``) node
+parameters are averaged — full-world via allreduce/N (``:56-59``) or, when
+``island_size < num_nodes``, rank 0 shuffles the rank list, broadcasts it
+(``:30-37``), ranks are partitioned into islands of ``island_size`` and each
+island partial-averages via all_gather + subset mean (``:61-69``).
 
 TPU-native restatement: the shuffle is a *shared PRNG permutation* (same key
 on every node — determinism replaces ``broadcast_object_list``), and the
-island partial average is an all_gather + membership-weighted mean. The
-periodic gate is a ``lax.cond`` on the step counter.
+island partial average is an all_gather + membership-weighted mean computed
+under the node axes. The periodic gate is a ``lax.cond`` on the step counter.
 """
 
 from __future__ import annotations
@@ -19,13 +19,63 @@ from typing import Optional, Union
 
 import jax
 import jax.numpy as jnp
-import optax
 
-from .base import PyTree, Strategy, tree_bytes
-from .optim import OptimSpec, ensure_optim_spec
+from .base import tree_bytes
+from .communicate_optimize import (CommunicateOptimizeStrategy,
+                                   CommunicationModule)
+from .optim import OptimSpec
 
 
-class FedAvgStrategy(Strategy):
+class AveragingCommunicator(CommunicationModule):
+    """Full or island-subset parameter averaging
+    (reference ``federated_averaging.py:16-82``)."""
+
+    def __init__(self, island_size: Optional[int] = None, seed: int = 1234):
+        self.island_size = island_size
+        self.seed = seed
+
+    def communicate(self, params, mstate, step, ctx):
+        k = ctx.num_nodes
+        if k == 1:
+            return params, mstate, jnp.zeros(())
+        psize = float(tree_bytes(params))
+        isl = self.island_size if self.island_size is not None else k
+
+        if isl >= k:
+            # full averaging — the reference's fast path (:56-59)
+            avg = ctx.pmean(params)
+            comm = jnp.asarray(2.0 * (k - 1) / k * psize)
+            return avg, mstate, comm
+
+        # Random islands: shared-PRNG shuffle of ranks, consecutive slices
+        # of size `isl` form islands (:30-47).
+        key = jax.random.fold_in(jax.random.PRNGKey(self.seed), step)
+        perm = jax.random.permutation(key, k)     # same on every node
+        pos = jnp.argsort(perm)                   # pos[r] = slot of rank r
+        island_of = pos // isl                    # [k] island id per rank
+        me = ctx.node_index()
+        member = (island_of == island_of[me])     # [k] bool
+        denom = jnp.sum(member)
+
+        gathered = ctx.all_gather(params)         # leaves [k, ...]
+
+        def island_mean(g):
+            w = member.astype(g.dtype).reshape((k,) + (1,) * (g.ndim - 1))
+            return jnp.sum(g * w, axis=0) / denom.astype(g.dtype)
+
+        avg = jax.tree.map(island_mean, gathered)
+        # all_gather: each node transmits its full model once (:61-69)
+        return avg, mstate, jnp.asarray(psize)
+
+    def config(self):
+        return {"module": "AveragingCommunicator",
+                "island_size": self.island_size}
+
+
+class FedAvgStrategy(CommunicateOptimizeStrategy):
+    """Local steps + periodic (island) averaging
+    (reference ``federated_averaging.py:85-117``)."""
+
     def __init__(
         self,
         inner_optim: Optional[Union[str, OptimSpec]] = None,
@@ -34,86 +84,22 @@ class FedAvgStrategy(Strategy):
         max_norm: Optional[float] = None,
         lr_scheduler=None,
         lr_scheduler_kwargs=None,
-        seed: int = 1234,
     ):
-        super().__init__(lr_scheduler, lr_scheduler_kwargs, max_norm)
-        self.optim_spec = ensure_optim_spec(inner_optim, OptimSpec("adamw"))
+        super().__init__(
+            communication_modules=[AveragingCommunicator(island_size)],
+            inner_optim=inner_optim,
+            max_norm=max_norm,
+            lr_scheduler=lr_scheduler,
+            lr_scheduler_kwargs=lr_scheduler_kwargs,
+        )
         self.island_size = island_size
         self.H = int(H)
-        self.seed = seed
-        self.tx: optax.GradientTransformation | None = None
 
-    def _build(self):
-        self.tx = self.optim_spec.build(self._lr_scale)
-
-    def init(self, params: PyTree) -> PyTree:
-        assert self._finalized, "call strategy.finalize(max_steps) first"
-        return {"opt": self.tx.init(params)}
-
-    def _island_average(self, params, step, ctx):
-        """Partial averaging over a random partition into islands.
-
-        All nodes compute the same permutation from a key folded with the
-        step, then average over their island's members using the gathered
-        parameter stack (reference ``:61-69``).
-        """
-        k = ctx.num_nodes
-        isl = self.island_size
-        key = jax.random.fold_in(jax.random.PRNGKey(self.seed), step)
-        perm = jax.random.permutation(key, k)  # shared: same on every node
-        # island id of each *rank*: position of rank r in perm, // isl
-        # (islands are consecutive slices of the shuffled rank list,
-        # reference :41-47)
-        pos = jnp.argsort(perm)          # pos[r] = index of rank r in perm
-        island_of = pos // isl           # [k] island id per rank
-        me = ctx.node_index()
-        my_island = island_of[me]
-        member = (island_of == my_island)  # [k] bool
-        denom = jnp.sum(member)
-
-        gathered = ctx.all_gather(params)  # leaves [k, ...]
-
-        def island_mean(g):
-            w = member.astype(g.dtype).reshape((k,) + (1,) * (g.ndim - 1))
-            return jnp.sum(g * w, axis=0) / denom.astype(g.dtype)
-
-        return jax.tree.map(island_mean, gathered)
-
-    def step(self, grads, params, state, step, ctx):
-        grads = self._maybe_clip(grads)
-        updates, opt_state = self.tx.update(grads, state["opt"], params)
-        params = optax.apply_updates(params, updates)
-
-        k = ctx.num_nodes
-        isl = self.island_size if self.island_size is not None else k
-        psize = tree_bytes(params)
-
-        def communicate(p):
-            if k == 1:
-                return p, jnp.zeros(())
-            if isl < k:
-                avg = self._island_average(p, step, ctx)
-                # all_gather transmits the full model once and receives k-1
-                # copies; count the transmit payload (reference counts were
-                # per-node transmitted bytes).
-                return avg, jnp.asarray(float(psize), jnp.float32)
-            avg = ctx.pmean(p)
-            return avg, jnp.asarray(2.0 * (k - 1) / k * psize, jnp.float32)
-
-        def no_comm(p):
-            return p, jnp.zeros(())
-
-        # local_step in the reference increments *after* step() runs, so the
-        # gate `local_step % H == 0 and local_step > 0` seen by communicate()
-        # corresponds to (step+1) % H == 0 here... careful: in the reference
-        # CommunicateOptimizeStrategy.step() calls _communicate() BEFORE
-        # super().step() increments local_step, so the gate uses the
-        # pre-increment counter — our `step` argument matches it exactly.
-        do = jnp.logical_and(step % self.H == 0, step > 0)
-        params, comm = jax.lax.cond(do, communicate, no_comm, params)
-        return params, {"opt": opt_state}, {"comm_bytes": comm}
+    def _should_communicate(self, step):
+        # reference gate: local_step % H == 0 and local_step > 0 (:108-111)
+        return jnp.logical_and(step % self.H == 0, step > 0)
 
     def config(self):
         cfg = super().config()
-        cfg.update({"H": self.H, "island_size": self.island_size})
+        cfg["H"] = self.H
         return cfg
