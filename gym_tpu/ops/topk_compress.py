@@ -1,0 +1,66 @@
+"""Per-chunk top-k sparsification with deterministic scatter-mean decode.
+
+Reference (``exogym/strategy/demo_impl/demo.py:302-352``): per chunk, keep
+the k largest-|coefficient| entries as (idx, val); decode scatters values
+back with ``scatter_reduce_(mean, include_self=False)`` — explicitly flagged
+nondeterministic on CUDA (``demo.py:338``). Here decode is a deterministic
+segment mean (scatter-add of values and counts, then divide), so replicas
+can never drift from reduction-order noise — one of the SPMD design's
+correctness wins (SURVEY §7 hard-parts).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def topk_compress(c: jnp.ndarray, k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """c: [n_chunks, chunk_elems] → (idx, val) each [n_chunks, k'].
+
+    k is clamped to [1, chunk_elems] (reference ``_clamp_topk``,
+    ``demo.py:307-312``). ``lax.top_k`` with a *static* k keeps shapes
+    XLA-friendly.
+    """
+    k = max(1, min(int(k), c.shape[-1]))
+    _, idx = lax.top_k(jnp.abs(c), k)
+    val = jnp.take_along_axis(c, idx, axis=-1)
+    return idx.astype(jnp.int32), val
+
+
+def scatter_mean_decode(idx: jnp.ndarray, val: jnp.ndarray,
+                        chunk_elems: int) -> jnp.ndarray:
+    """(idx, val) [n_chunks, m] → dense [n_chunks, chunk_elems].
+
+    Duplicate indices (after concatenating K nodes' picks) are averaged;
+    untouched slots decode to 0 — the semantics of the reference's
+    include_self=False scatter-mean, made deterministic.
+    """
+    n_chunks, m = idx.shape
+    offset = (jnp.arange(n_chunks, dtype=jnp.int32) * chunk_elems)[:, None]
+    flat_idx = (idx + offset).reshape(-1)
+    flat_val = val.reshape(-1)
+    size = n_chunks * chunk_elems
+    sums = jnp.zeros((size,), val.dtype).at[flat_idx].add(flat_val)
+    cnts = jnp.zeros((size,), val.dtype).at[flat_idx].add(1.0)
+    out = jnp.where(cnts > 0, sums / jnp.maximum(cnts, 1.0), 0.0)
+    return out.reshape(n_chunks, chunk_elems)
+
+
+def gather_concat(ctx, idx: jnp.ndarray, val: jnp.ndarray):
+    """All-gather each node's (idx, val) and concatenate along the k axis —
+    the reference's paired async all_gathers + concat
+    (``demo.py:119-140``, ``demo.py:349-352``)."""
+    g_idx = ctx.all_gather(idx)   # [K, n_chunks, k]
+    g_val = ctx.all_gather(val)
+    k_nodes = g_idx.shape[0]
+    cat_idx = jnp.moveaxis(g_idx, 0, -2).reshape(
+        idx.shape[0], k_nodes * idx.shape[1]
+    )
+    cat_val = jnp.moveaxis(g_val, 0, -2).reshape(
+        val.shape[0], k_nodes * val.shape[1]
+    )
+    return cat_idx, cat_val

@@ -9,6 +9,7 @@ reference's re-exports — SURVEY §2.1).
 from .base import Strategy
 from .communicate_optimize import (CommunicateOptimizeStrategy,
                                    CommunicationModule)
+from .demo import DeMoStrategy
 from .diloco import DiLoCoCommunicator, DiLoCoStrategy
 from .fedavg import AveragingCommunicator, FedAvgStrategy
 from .optim import OptimSpec, ensure_optim_spec
@@ -36,4 +37,5 @@ __all__ = [
     "ShuffledSequentialIndexSelector",
     "PartitionedIndexSelector",
     "SPARTADiLoCoStrategy",
+    "DeMoStrategy",
 ]
