@@ -1,5 +1,13 @@
+from .build_dataset import (build_dataset_owt, build_dataset_small,
+                            char_vocab_size, generate_char_vocab, get_dataset)
+from .gpt_datasets import (ContiguousGPTTrainDataset,
+                           LazyNonContiguousGPTTrainDataset,
+                           NonContiguousGPTTrainDataset)
 from .sampler import (ArrayDataset, IndexedDataset, NodeBatchIterator,
                       as_dataset, resolve_node_datasets)
 
 __all__ = ["ArrayDataset", "IndexedDataset", "NodeBatchIterator",
-           "as_dataset", "resolve_node_datasets"]
+           "as_dataset", "resolve_node_datasets", "get_dataset",
+           "build_dataset_small", "build_dataset_owt", "generate_char_vocab",
+           "char_vocab_size", "ContiguousGPTTrainDataset",
+           "NonContiguousGPTTrainDataset", "LazyNonContiguousGPTTrainDataset"]

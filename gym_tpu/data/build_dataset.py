@@ -1,0 +1,189 @@
+"""Tokenized-corpus build pipeline (reference ``example/nanogpt/build_dataset.py``).
+
+``build_dataset_small`` (reference ``:24-159``): shakespeare (char-level, the
+reference's fixed 66-token vocabulary incl. ``<EOS>``) or wikitext (GPT-2
+BPE); slices records by ``[start_pc, end_pc)``, tokenizes, flattens into one
+1-D stream with EOS separators, caches as ``.npy`` — cache layout
+(``data/<name>_char/data_block<B>_<s>_<e>.npy``) matches the reference so
+existing caches are reusable.
+
+``build_dataset_owt`` (reference ``:162-324``): OpenWebText → fixed
+1024-token rows → numbered ``chunk_<id>.npy`` files.
+
+This environment may have no network egress; when HuggingFace ``datasets``
+can't fetch, a deterministic synthetic corpus with the same vocabulary and
+format is generated instead (clearly logged) so every downstream path stays
+exercisable.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Tuple
+
+import numpy as np
+
+# The reference's fixed character vocabulary (build_dataset.py:8-21); kept
+# byte-identical so cached .npy token streams are interchangeable.
+CHAR_VOCAB = (
+    " !$&',-.3:;?ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+    "abcdefghijklmnopqrstuvwxyz\n"
+)
+GPT2_VOCAB_SIZE = 50257
+
+
+def generate_char_vocab():
+    char_int = {c: i for i, c in enumerate(CHAR_VOCAB)}
+    eos_id = len(char_int)
+    char_int["<EOS>"] = eos_id
+    return char_int, eos_id
+
+
+def char_vocab_size() -> int:
+    return len(CHAR_VOCAB) + 1  # + <EOS> = 66
+
+
+def _log(msg: str):
+    print(f"[gym_tpu.data] {msg}", file=sys.stderr)
+
+
+def _synthetic_char_stream(n_tokens: int, seed: int = 0) -> np.ndarray:
+    """Deterministic pseudo-text over the char vocabulary: word-like bursts
+    with punctuation and EOS separators — learnable structure for
+    convergence tests, zero network required."""
+    rng = np.random.default_rng(seed)
+    char_int, eos = generate_char_vocab()
+    words = ["the", "quick", "brown", "fox", "jumps", "over", "lazy",
+             "dog", "lord", "king", "speak", "thou", "art", "crown",
+             "night", "day", "sweet", "sorrow", "love", "death"]
+    out = []
+    while len(out) < n_tokens:
+        sent = []
+        for w in rng.choice(words, size=rng.integers(4, 9)):
+            sent.extend(char_int[c] for c in w)
+            sent.append(char_int[" "])
+        sent[-1] = char_int["."]
+        sent.append(char_int["\n"])
+        if rng.random() < 0.1:
+            sent.append(eos)
+        out.extend(sent)
+    return np.asarray(out[:n_tokens], np.uint16)
+
+
+def _synthetic_bpe_stream(n_tokens: int, seed: int = 0) -> np.ndarray:
+    """Zipf-distributed pseudo-BPE ids (offline wikitext stand-in)."""
+    rng = np.random.default_rng(seed)
+    toks = rng.zipf(1.3, size=n_tokens) % GPT2_VOCAB_SIZE
+    return toks.astype(np.uint16)
+
+
+def _try_hf_small(dataset: str, start_pc: float, end_pc: float):
+    """Fetch + tokenize via HuggingFace datasets; None if unavailable."""
+    try:
+        from datasets import concatenate_datasets, load_dataset
+
+        name, conf = (("tiny_shakespeare", None) if dataset == "shakespeare"
+                      else ("wikitext", "wikitext-103-v1"))
+        if dataset == "shakespeare":
+            raw = load_dataset("Trelis/tiny-shakespeare")
+        else:
+            raw = load_dataset(name, conf)
+        parts = [raw[s] for s in raw.keys()]
+        ds = concatenate_datasets(parts)
+        n = len(ds)
+        lo, hi = int(n * start_pc), int(n * end_pc)
+        ds = ds.select(range(lo, hi))
+        texts = [r[list(r.keys())[0]] for r in ds]
+        if dataset == "shakespeare":
+            char_int, eos = generate_char_vocab()
+            stream = []
+            for t in texts:
+                stream.extend(char_int[c] for c in t if c in char_int)
+                stream.append(eos)
+            return np.asarray(stream, np.uint16)
+        from transformers import GPT2Tokenizer
+        tok = GPT2Tokenizer.from_pretrained("gpt2")
+        stream = []
+        for t in texts:
+            stream.extend(tok.encode(t))
+            stream.append(tok.eos_token_id)
+        return np.asarray(stream, np.uint16)
+    except Exception as e:  # offline / missing dep — fall back
+        _log(f"HF fetch for {dataset!r} unavailable ({type(e).__name__}); "
+             f"using deterministic synthetic corpus")
+        return None
+
+
+def build_dataset_small(
+    dataset: str, block_size: int = 1024,
+    start_pc: float = 0.0, end_pc: float = 1.0,
+    data_root: str = "data",
+) -> Tuple[np.ndarray, int]:
+    assert dataset in ("shakespeare", "wikitext")
+    char = dataset == "shakespeare"
+    cache_dir = os.path.join(data_root,
+                             f"{dataset}_char" if char else dataset)
+    os.makedirs(cache_dir, exist_ok=True)
+    cache = os.path.join(
+        cache_dir, f"data_block{block_size}_{start_pc}_{end_pc}.npy"
+    )
+    vocab = char_vocab_size() if char else GPT2_VOCAB_SIZE
+    if os.path.exists(cache):
+        return np.load(cache), vocab
+
+    data = _try_hf_small(dataset, start_pc, end_pc)
+    if data is None:
+        span = max(1e-6, end_pc - start_pc)
+        n = int(2_000_000 * span) if char else int(1_000_000 * span)
+        seed = hash((dataset, round(start_pc, 6), round(end_pc, 6))) % (2**31)
+        data = (_synthetic_char_stream(n, seed) if char
+                else _synthetic_bpe_stream(n, seed))
+    np.save(cache, data)
+    return data, vocab
+
+
+def build_dataset_owt(
+    start_pc: float = 0.0, end_pc: float = 1.0,
+    data_root: str = "data", n_target_chunks: int = 1000,
+    rows_per_chunk: int = 256, row_len: int = 1024,
+) -> Tuple[list, str, int]:
+    """OpenWebText chunk files (reference ``:162-324``): the percentage range
+    selects a contiguous chunk-id window out of ``n_target_chunks``. Offline,
+    synthetic chunks are materialized with identical layout."""
+    cache_location = os.path.join(data_root, "owt")
+    os.makedirs(cache_location, exist_ok=True)
+    first = int(n_target_chunks * start_pc)
+    last = max(first + 1, int(n_target_chunks * end_pc))
+    chunk_ids = list(range(first, last))
+    for cid in chunk_ids:
+        path = os.path.join(cache_location, f"chunk_{cid}.npy")
+        if not os.path.exists(path):
+            rows = _synthetic_bpe_stream(
+                rows_per_chunk * row_len, seed=cid
+            ).reshape(rows_per_chunk, row_len)
+            np.save(path, rows)
+    return chunk_ids, cache_location, GPT2_VOCAB_SIZE
+
+
+def get_dataset(
+    dataset_name: str, block_size: int,
+    start_pc: float = 0.0, end_pc: float = 1.0,
+    max_chunks_in_memory: int = None, data_root: str = "data",
+):
+    """Dataset selector (reference ``example/nanogpt/dataset.py:20-47``):
+    returns (dataset, vocab_size)."""
+    from .gpt_datasets import (ContiguousGPTTrainDataset,
+                               LazyNonContiguousGPTTrainDataset)
+
+    if dataset_name != "owt":
+        data, vocab_size = build_dataset_small(
+            dataset_name, block_size, start_pc, end_pc, data_root
+        )
+        return ContiguousGPTTrainDataset(data, block_size), vocab_size
+    chunk_ids, cache_location, vocab_size = build_dataset_owt(
+        start_pc, end_pc, data_root
+    )
+    return LazyNonContiguousGPTTrainDataset(
+        chunk_ids, cache_location, max_chunks_in_memory
+    ), vocab_size

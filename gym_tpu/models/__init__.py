@@ -1,4 +1,9 @@
 from .base import LossModel, as_loss_model
 from .mnist_cnn import CNN, MnistLossModel
+from .nanogpt import (GPT, GPTConfig, crop_block_size, decay_mask,
+                      estimate_mfu, from_pretrained, generate, make_adamw,
+                      num_params)
 
-__all__ = ["LossModel", "as_loss_model", "CNN", "MnistLossModel"]
+__all__ = ["LossModel", "as_loss_model", "CNN", "MnistLossModel", "GPT",
+           "GPTConfig", "crop_block_size", "decay_mask", "estimate_mfu",
+           "from_pretrained", "generate", "make_adamw", "num_params"]

@@ -1,0 +1,310 @@
+"""nanoGPT in Flax, matching the reference model family.
+
+Reference (``example/nanogpt/nanogpt.py``): Karpathy-style GPT with
+LayerNorm (optional bias, ``:19-28``), causal self-attention (``:47-94``),
+GELU MLP (``:104-123``), pre-norm residual blocks (``:126-133``),
+``GPTConfig`` + size map small(4L/4H/128)/base/medium/large/xl
+(``:136-179``), weight tying (``:206-208``), scaled residual init 0.02/√(2L)
+(``:213-217``), ``forward(batch) -> loss`` (``:244-276``),
+``crop_block_size`` (``:278-289``), HF GPT-2 weight port (``:291-360``),
+decay/no-decay optimizer grouping (``:362-392``), MFU estimator (``:394-408``)
+and sampling ``generate`` (``:410-439``).
+
+TPU-first: attention goes through the ``gym_tpu.ops.attention`` interface
+(dense XLA now, ring/Pallas drop-in), softmax/loss in f32 with bf16-friendly
+matmuls, and everything is static-shape for XLA.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..ops.attention import dense_causal_attention
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    block_size: int = 1024
+    vocab_size: int = 50304  # GPT-2 50257 padded to a multiple of 64
+    n_layer: int = 12
+    n_head: int = 12
+    n_embd: int = 768
+    dropout: float = 0.0
+    bias: bool = True
+
+    @classmethod
+    def gpt2_size_map(cls, size: str) -> "GPTConfig":
+        return {
+            "small": cls.gpt2_small,
+            "base": cls.gpt2_base,
+            "medium": cls.gpt2_medium,
+            "large": cls.gpt2_large,
+            "xl": cls.gpt2_xl,
+        }[size]()
+
+    @classmethod
+    def gpt2_small(cls):
+        # the reference's nonstandard "small": 4 layers / 4 heads / 128 dim
+        return cls(n_layer=4, n_head=4, n_embd=128)
+
+    @classmethod
+    def gpt2_base(cls):
+        return cls(n_layer=12, n_head=12, n_embd=768)
+
+    @classmethod
+    def gpt2_medium(cls):
+        return cls(n_layer=24, n_head=16, n_embd=1024)
+
+    @classmethod
+    def gpt2_large(cls):
+        return cls(n_layer=36, n_head=20, n_embd=1280)
+
+    @classmethod
+    def gpt2_xl(cls):
+        return cls(n_layer=48, n_head=25, n_embd=1600)
+
+
+def _init_normal(std: float):
+    return nn.initializers.normal(stddev=std)
+
+
+class CausalSelfAttention(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cfg = self.config
+        b, t, c = x.shape
+        assert c % cfg.n_head == 0
+        hd = c // cfg.n_head
+        qkv = nn.Dense(3 * c, use_bias=cfg.bias,
+                       kernel_init=_init_normal(0.02), name="c_attn")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(z):
+            return z.reshape(b, t, cfg.n_head, hd).transpose(0, 2, 1, 3)
+
+        rng = self.make_rng("dropout") if (train and cfg.dropout > 0) else None
+        y = dense_causal_attention(
+            heads(q), heads(k), heads(v),
+            dropout_rate=cfg.dropout, dropout_rng=rng,
+            deterministic=not train,
+        )
+        y = y.transpose(0, 2, 1, 3).reshape(b, t, c)
+        # residual projection: scaled init per GPT-2 paper (reference :213-217)
+        y = nn.Dense(c, use_bias=cfg.bias,
+                     kernel_init=_init_normal(0.02 / math.sqrt(2 * cfg.n_layer)),
+                     name="c_proj")(y)
+        y = nn.Dropout(cfg.dropout, deterministic=not train)(y)
+        return y
+
+
+class MLP(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cfg = self.config
+        x = nn.Dense(4 * cfg.n_embd, use_bias=cfg.bias,
+                     kernel_init=_init_normal(0.02), name="c_fc")(x)
+        x = nn.gelu(x)
+        x = nn.Dense(cfg.n_embd, use_bias=cfg.bias,
+                     kernel_init=_init_normal(0.02 / math.sqrt(2 * cfg.n_layer)),
+                     name="c_proj")(x)
+        return nn.Dropout(cfg.dropout, deterministic=not train)(x)
+
+
+class Block(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cfg = self.config
+        x = x + CausalSelfAttention(cfg, name="attn")(
+            nn.LayerNorm(use_bias=cfg.bias, name="ln_1")(x), train
+        )
+        x = x + MLP(cfg, name="mlp")(
+            nn.LayerNorm(use_bias=cfg.bias, name="ln_2")(x), train
+        )
+        return x
+
+
+class GPT(nn.Module):
+    """``__call__(batch, train)``: a ``(idx, targets)`` tuple → scalar loss
+    (targets == -1 are ignored); a bare ``idx`` array → logits [B, T, V]."""
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, batch, train: bool = True):
+        cfg = self.config
+        if isinstance(batch, (tuple, list)):
+            idx, targets = batch
+        else:
+            idx, targets = batch, None
+        b, t = idx.shape
+        assert t <= cfg.block_size, (
+            f"sequence length {t} > block_size {cfg.block_size}"
+        )
+        wte = nn.Embed(cfg.vocab_size, cfg.n_embd,
+                       embedding_init=_init_normal(0.02), name="wte")
+        wpe = nn.Embed(cfg.block_size, cfg.n_embd,
+                       embedding_init=_init_normal(0.02), name="wpe")
+        pos = jnp.arange(t)[None, :]
+        x = wte(idx) + wpe(pos)
+        x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
+        for i in range(cfg.n_layer):
+            x = Block(cfg, name=f"h_{i}")(x, train)
+        x = nn.LayerNorm(use_bias=cfg.bias, name="ln_f")(x)
+        # weight tying: lm_head = wteᵀ (reference :206-208)
+        logits = wte.attend(x.astype(wte.embedding.dtype))
+        if targets is None:
+            return logits
+        logits = logits.astype(jnp.float32)
+        losses = optax.softmax_cross_entropy_with_integer_labels(
+            logits.reshape(-1, cfg.vocab_size),
+            jnp.maximum(targets.reshape(-1), 0),
+        )
+        valid = (targets.reshape(-1) >= 0).astype(jnp.float32)
+        return jnp.sum(losses * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+
+# -- model utilities (reference parity helpers) ----------------------------
+
+
+def num_params(params: Any, non_embedding: bool = True) -> int:
+    """Parameter count; positional embeddings subtracted by default
+    (token embeddings stay — they serve as lm_head via tying;
+    reference ``:223-231``)."""
+    total = sum(int(x.size) for x in jax.tree.leaves(params))
+    if non_embedding:
+        total -= int(params["wpe"]["embedding"].size)
+    return total
+
+
+def crop_block_size(params: Any, config: GPTConfig,
+                    block_size: int) -> Tuple[Any, GPTConfig]:
+    """Shrink the context window by slicing wpe (reference ``:278-289``)."""
+    assert block_size <= config.block_size
+    new = jax.tree.map(lambda x: x, params)  # shallow copy
+    new["wpe"] = {"embedding": params["wpe"]["embedding"][:block_size]}
+    return new, dataclasses.replace(config, block_size=block_size)
+
+
+def decay_mask(params: Any) -> Any:
+    """optax weight-decay mask: decay only ≥2-D kernels/embeddings, never
+    biases or LayerNorm scales — the reference's decay/no-decay param
+    grouping (``:362-392``) expressed as a mask."""
+    return jax.tree.map(lambda x: x.ndim >= 2, params)
+
+
+def make_adamw(lr, betas=(0.9, 0.95), weight_decay=0.1, params=None):
+    """AdamW with nanoGPT-style decay grouping (reference ``:381-390``)."""
+    return optax.adamw(lr, b1=betas[0], b2=betas[1],
+                       weight_decay=weight_decay,
+                       mask=decay_mask(params) if params is not None else None)
+
+
+def estimate_mfu(config: GPTConfig, params: Any, fwdbwd_per_iter: float,
+                 dt: float, peak_flops: float = 197e12) -> float:
+    """Model FLOPs utilization. Default peak is TPU v5e bf16 (197 TFLOP/s)
+    rather than the reference's A100 312 TFLOPS (``:394-408``)."""
+    n = num_params(params)
+    cfg = config
+    l, h, q, t = cfg.n_layer, cfg.n_head, cfg.n_embd // cfg.n_head, \
+        cfg.block_size
+    flops_per_token = 6 * n + 12 * l * h * q * t
+    flops_per_iter = flops_per_token * t * fwdbwd_per_iter
+    return (flops_per_iter / dt) / peak_flops
+
+
+def generate(params: Any, config: GPTConfig, idx: np.ndarray,
+             max_new_tokens: int, temperature: float = 1.0,
+             top_k: Optional[int] = None, seed: int = 0) -> np.ndarray:
+    """Autoregressive sampling (reference ``:410-439``): crop context to
+    block_size, temperature-scale, optional top-k filter, categorical
+    sample."""
+    model = GPT(config)
+
+    @jax.jit
+    def logits_fn(p, tokens):
+        return model.apply({"params": p}, tokens, train=False)
+
+    key = jax.random.PRNGKey(seed)
+    idx = np.asarray(idx)
+    for _ in range(max_new_tokens):
+        ctx = idx[:, -config.block_size:]
+        logits = np.asarray(logits_fn(params, jnp.asarray(ctx)))[:, -1, :]
+        logits = logits / temperature
+        if top_k is not None:
+            kth = np.sort(logits, axis=-1)[:, -min(top_k, logits.shape[-1])]
+            logits = np.where(logits < kth[:, None], -np.inf, logits)
+        key, sub = jax.random.split(key)
+        nxt = jax.random.categorical(sub, jnp.asarray(logits), axis=-1)
+        idx = np.concatenate([idx, np.asarray(nxt)[:, None]], axis=1)
+    return idx
+
+
+def from_pretrained(model_type: str, override_args: Optional[dict] = None):
+    """Port HF GPT-2 weights into our param tree (reference ``:291-360``).
+
+    Requires the ``transformers`` GPT-2 checkpoint to be available locally
+    (this environment has no network egress; pass a cached path via
+    ``override_args={'model_path': ...}``).
+    """
+    config_args = {
+        "gpt2": dict(n_layer=12, n_head=12, n_embd=768),
+        "gpt2-medium": dict(n_layer=24, n_head=16, n_embd=1024),
+        "gpt2-large": dict(n_layer=36, n_head=20, n_embd=1280),
+        "gpt2-xl": dict(n_layer=48, n_head=25, n_embd=1600),
+    }[model_type]
+    override_args = dict(override_args or {})
+    model_path = override_args.pop("model_path", model_type)
+    if "dropout" in override_args:
+        config_args["dropout"] = override_args.pop("dropout")
+    config = GPTConfig(vocab_size=50257, block_size=1024, bias=True,
+                       **config_args)
+
+    from transformers import GPT2LMHeadModel  # lazy: optional dep
+    hf = GPT2LMHeadModel.from_pretrained(model_path)
+    sd = {k: v.detach().cpu().numpy() for k, v in hf.state_dict().items()}
+
+    def dense(prefix, has_bias=True):
+        # HF GPT-2 uses Conv1D ([in, out]) — same layout as flax Dense
+        out = {"kernel": sd[f"{prefix}.weight"]}
+        if has_bias:
+            out["bias"] = sd[f"{prefix}.bias"]
+        return out
+
+    def ln(prefix):
+        return {"scale": sd[f"{prefix}.weight"], "bias": sd[f"{prefix}.bias"]}
+
+    params = {
+        "wte": {"embedding": sd["transformer.wte.weight"]},
+        "wpe": {"embedding": sd["transformer.wpe.weight"]},
+        "ln_f": ln("transformer.ln_f"),
+    }
+    for i in range(config.n_layer):
+        p = f"transformer.h.{i}"
+        params[f"h_{i}"] = {
+            "ln_1": ln(f"{p}.ln_1"),
+            "ln_2": ln(f"{p}.ln_2"),
+            "attn": {
+                "c_attn": dense(f"{p}.attn.c_attn"),
+                "c_proj": dense(f"{p}.attn.c_proj"),
+            },
+            "mlp": {
+                "c_fc": dense(f"{p}.mlp.c_fc"),
+                "c_proj": dense(f"{p}.mlp.c_proj"),
+            },
+        }
+    params = jax.tree.map(jnp.asarray, params)
+    return GPT(config), params, config

@@ -1,0 +1,46 @@
+"""Attention ops behind a single interface.
+
+The reference uses torch ``F.scaled_dot_product_attention`` (flash when
+available) inside dense single-device attention
+(``example/nanogpt/nanogpt.py:47-94``); long-context/sequence parallelism is
+absent (SURVEY §5.7). Here attention is an interface so the GPT block can
+swap implementations without touching callers:
+
+- ``dense_causal_attention`` — XLA-fused reference implementation; softmax
+  in f32 (bf16 logits lose too much range on TPU).
+- ``ring_causal_attention`` (``gym_tpu/parallel/ring_attention.py``) —
+  context-parallel blockwise attention over an ICI ring via ``ppermute``.
+- a Pallas flash kernel can slot in the same signature on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def dense_causal_attention(
+    q: jnp.ndarray,  # [B, H, T, D]
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
+    deterministic: bool = True,
+) -> jnp.ndarray:
+    """Causal softmax(QKᵀ/√d)V with f32 accumulation."""
+    t = q.shape[-2]
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    causal = jnp.tril(jnp.ones((t, t), bool))
+    logits = jnp.where(causal, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_rate > 0.0 and not deterministic:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
+                                    probs.shape)
+        probs = probs * keep / (1.0 - dropout_rate)
+    probs = probs.astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)

@@ -1,0 +1,177 @@
+"""nanoGPT model-family and GPT-data-pipeline tests (reference
+``example/nanogpt/`` parity: config size map, tying, init scheme, loss
+contract, crop, generate, dataset classes)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gym_tpu.data import (ContiguousGPTTrainDataset,
+                          LazyNonContiguousGPTTrainDataset,
+                          NonContiguousGPTTrainDataset, build_dataset_owt,
+                          build_dataset_small, char_vocab_size, get_dataset)
+from gym_tpu.models import GPT, GPTConfig, crop_block_size, generate, \
+    num_params
+from gym_tpu.models.nanogpt import decay_mask
+
+
+def tiny_cfg(**kw):
+    base = dict(block_size=32, vocab_size=66, n_layer=2, n_head=2,
+                n_embd=32, dropout=0.1, bias=True)
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+def test_config_size_map():
+    small = GPTConfig.gpt2_size_map("small")
+    assert (small.n_layer, small.n_head, small.n_embd) == (4, 4, 128)
+    base = GPTConfig.gpt2_size_map("base")
+    assert (base.n_layer, base.n_head, base.n_embd) == (12, 12, 768)
+    xl = GPTConfig.gpt2_size_map("xl")
+    assert (xl.n_layer, xl.n_head, xl.n_embd) == (48, 25, 1600)
+
+
+def test_forward_loss_and_logits():
+    cfg = tiny_cfg()
+    model = GPT(cfg)
+    idx = np.random.default_rng(0).integers(0, 66, (2, 16)).astype(np.int32)
+    tgt = np.roll(idx, -1, axis=1)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        (idx, tgt), train=False,
+    )
+    loss = model.apply(variables, (idx, tgt), train=False)
+    assert loss.shape == ()
+    assert np.isfinite(float(loss))
+    # untrained loss ≈ ln(vocab)
+    assert abs(float(loss) - np.log(66)) < 1.0
+    logits = model.apply(variables, idx, train=False)
+    assert logits.shape == (2, 16, 66)
+    # ignore_index=-1 semantics
+    tgt_ig = tgt.copy()
+    tgt_ig[:, 8:] = -1
+    loss_ig = model.apply(variables, (idx, tgt_ig), train=False)
+    assert np.isfinite(float(loss_ig))
+
+
+def test_weight_tying_and_init_scale():
+    cfg = tiny_cfg(dropout=0.0)
+    model = GPT(cfg)
+    idx = np.zeros((1, 8), np.int32)
+    variables = model.init({"params": jax.random.PRNGKey(0)}, idx,
+                           train=False)
+    params = variables["params"]
+    # tying: there is no separate lm_head kernel
+    assert "lm_head" not in params
+    # scaled residual init: c_proj std ≈ 0.02/sqrt(2*n_layer)
+    cp = np.asarray(params["h_0"]["attn"]["c_proj"]["kernel"])
+    assert 0.3 * 0.02 < cp.std() < 1.2 * 0.02 / np.sqrt(2 * cfg.n_layer) * 2
+    # wte/wpe std ≈ 0.02
+    assert abs(np.asarray(params["wte"]["embedding"]).std() - 0.02) < 0.005
+
+
+def test_num_params_and_crop_and_decay_mask():
+    cfg = tiny_cfg(dropout=0.0)
+    model = GPT(cfg)
+    idx = np.zeros((1, 8), np.int32)
+    params = model.init({"params": jax.random.PRNGKey(0)}, idx,
+                        train=False)["params"]
+    n = num_params(params)
+    assert n > 0
+    new_params, new_cfg = crop_block_size(params, cfg, 16)
+    assert new_cfg.block_size == 16
+    assert new_params["wpe"]["embedding"].shape[0] == 16
+    out = GPT(new_cfg).apply({"params": new_params},
+                             np.zeros((1, 16), np.int32), train=False)
+    assert out.shape == (1, 16, 66)
+    mask = decay_mask(params)
+    assert mask["wte"]["embedding"] is True
+    assert mask["ln_f"]["scale"] is False
+    assert mask["h_0"]["attn"]["c_attn"]["bias"] is False
+
+
+def test_generate():
+    cfg = tiny_cfg(dropout=0.0)
+    model = GPT(cfg)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        np.zeros((1, 8), np.int32), train=False)["params"]
+    out = generate(params, cfg, np.zeros((2, 4), np.int64), max_new_tokens=5,
+                   top_k=10)
+    assert out.shape == (2, 9)
+    assert np.all((out >= 0) & (out < 66))
+
+
+def test_gpt_trains_on_mesh():
+    """16-node FedAvg on a char-level GPT (BASELINE config #4 shape, tiny)."""
+    from gym_tpu import Trainer
+    from gym_tpu.strategy import FedAvgStrategy, OptimSpec
+
+    data, vocab = build_dataset_small("shakespeare", block_size=32,
+                                      start_pc=0.0, end_pc=0.01,
+                                      data_root="/tmp/gym_tpu_data")
+    ds = ContiguousGPTTrainDataset(data, block_size=32)
+    cfg = tiny_cfg(vocab_size=vocab, dropout=0.0)
+    res = Trainer(GPT(cfg), ds, ds).fit(
+        strategy=FedAvgStrategy(inner_optim=OptimSpec("adamw", lr=3e-3),
+                                H=5),
+        num_nodes=16, max_steps=25, batch_size=8, minibatch_size=8,
+        val_size=8, val_interval=10, show_progress=False,
+        log_dir="/tmp/gym_tpu_test_logs",
+    )
+    first = res.history["train_loss"][0][1]
+    last = np.mean([l for _, l in res.history["train_loss"][-5:]])
+    assert last < first, (first, last)
+
+
+# -- data pipeline ---------------------------------------------------------
+
+
+def test_contiguous_dataset_windows():
+    data = np.arange(100, dtype=np.uint16)
+    ds = ContiguousGPTTrainDataset(data, block_size=8)
+    assert len(ds) == 100 - 8 - 1
+    x, y = ds.take(np.array([0, 5]))
+    np.testing.assert_array_equal(x[0], np.arange(8))
+    np.testing.assert_array_equal(y[0], np.arange(1, 9))
+    np.testing.assert_array_equal(x[1], np.arange(5, 13))
+
+
+def test_noncontiguous_dataset():
+    rows = np.arange(40, dtype=np.uint16).reshape(4, 10)
+    ds = NonContiguousGPTTrainDataset(rows)
+    x, y = ds.take(np.array([1, 3]))
+    np.testing.assert_array_equal(x[0], rows[1, :-1])
+    np.testing.assert_array_equal(y[1], rows[3, 1:])
+
+
+def test_lazy_owt_chunks(tmp_path):
+    ids, loc, vocab = build_dataset_owt(0.0, 0.004,
+                                        data_root=str(tmp_path),
+                                        rows_per_chunk=8, row_len=16)
+    ds = LazyNonContiguousGPTTrainDataset(ids, loc, max_chunks_in_memory=2)
+    assert len(ds) == len(ids) * 8
+    x, y = ds.take(np.array([0, 9, 17]))
+    assert x.shape == (3, 15) and y.shape == (3, 15)
+    np.testing.assert_array_equal(x[0][1:], y[0][:-1])
+
+
+def test_build_dataset_small_cache_roundtrip(tmp_path):
+    d1, v1 = build_dataset_small("shakespeare", 32, 0.0, 0.01,
+                                 data_root=str(tmp_path))
+    d2, v2 = build_dataset_small("shakespeare", 32, 0.0, 0.01,
+                                 data_root=str(tmp_path))
+    assert v1 == v2 == char_vocab_size() == 66
+    np.testing.assert_array_equal(d1, d2)  # cache hit identical
+    assert d1.max() < 66
+
+
+def test_get_dataset_selector(tmp_path):
+    ds, vocab = get_dataset("shakespeare", 16, 0.0, 0.01,
+                            data_root=str(tmp_path))
+    assert vocab == 66 and len(ds) > 0
+    ds2, vocab2 = get_dataset("owt", 16, 0.0, 0.002,
+                              data_root=str(tmp_path))
+    assert vocab2 == 50257 and len(ds2) > 0
