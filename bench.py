@@ -1,0 +1,104 @@
+"""Benchmark: nanoGPT DiLoCo at 64 simulated nodes (the BASELINE.json
+north-star config — ``example/nanogpt.py`` with ``--strategy diloco``,
+64 nodes) on the current accelerator.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": it/s, "unit": "it/s", "vs_baseline": ...}
+
+``vs_baseline`` is measured it/s divided by the CPU it/s of the *same*
+workload (the north star is ">=10x CPU iterations/sec"). The CPU number is
+re-measurable with ``python bench.py --cpu`` and overridable via
+``GYM_TPU_BENCH_BASELINE``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+CPU_BASELINE_IT_S = 0.008  # measured on this host: `python bench.py --cpu`
+# (64-node nanoGPT DiLoCo on 8 virtual CPU devices: ~125 s/step)
+
+NUM_NODES = 64
+BLOCK_SIZE = 256
+VOCAB = 65          # shakespeare char vocab (reference build_dataset.py:8-21)
+BATCH_PER_NODE = 16
+WARMUP = int(os.environ.get("GYM_TPU_BENCH_WARMUP", 3))
+TIMED = int(os.environ.get("GYM_TPU_BENCH_STEPS", 20))
+
+
+def main() -> None:
+    force_cpu = "--cpu" in sys.argv
+    if force_cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    if force_cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from gym_tpu.models.base import LossModel
+    from gym_tpu.models.nanogpt import GPT, GPTConfig
+    from gym_tpu.parallel.mesh import NodeRuntime
+    from gym_tpu.strategy.diloco import DiLoCoStrategy
+    from gym_tpu.strategy.optim import OptimSpec
+    from gym_tpu.train_node import make_init_fn, make_train_step
+
+    cfg = GPTConfig(block_size=BLOCK_SIZE, vocab_size=VOCAB, n_layer=4,
+                    n_head=4, n_embd=128, dropout=0.0, bias=True)
+    loss_model = LossModel(GPT(cfg))
+
+    strategy = DiLoCoStrategy(
+        optim_spec=OptimSpec("adamw", lr=3e-4), H=100,
+        lr_scheduler="lambda_cosine",
+        lr_scheduler_kwargs={"warmup_steps": 100},
+    )
+    strategy.finalize(max_steps=WARMUP + TIMED)
+
+    runtime = NodeRuntime.create(NUM_NODES, jax.devices())
+
+    rng = np.random.default_rng(0)
+    idx = rng.integers(
+        0, VOCAB, (NUM_NODES, 1, BATCH_PER_NODE, BLOCK_SIZE), dtype=np.int64
+    )
+    batch = runtime.shard_batch((idx, np.roll(idx, -1, axis=-1)))
+
+    init_fn = make_init_fn(loss_model, strategy, (idx[0, 0], idx[0, 0]),
+                           seed=42)
+    state = runtime.init_state(init_fn)
+    train_step = runtime.compile(make_train_step(loss_model, strategy,
+                                                 runtime.ctx))
+
+    for _ in range(WARMUP):
+        state, metrics = train_step(state, batch)
+    # NB: device_get, not block_until_ready — some transport backends
+    # (e.g. the axon tunnel) resolve block_until_ready before execution
+    # finishes; fetching a value that depends on the whole step chain is
+    # the only honest fence.
+    float(np.asarray(metrics["loss"]).sum())
+
+    t0 = time.perf_counter()
+    for _ in range(TIMED):
+        state, metrics = train_step(state, batch)
+    loss = float(np.asarray(metrics["loss"]).mean())
+    dt = time.perf_counter() - t0
+
+    it_s = TIMED / dt
+    assert np.isfinite(loss), f"non-finite loss {loss}"
+
+    baseline = float(os.environ.get("GYM_TPU_BENCH_BASELINE",
+                                    CPU_BASELINE_IT_S))
+    print(json.dumps({
+        "metric": "nanogpt_diloco_64node_iterations_per_sec",
+        "value": round(it_s, 3),
+        "unit": "it/s",
+        "vs_baseline": round(it_s / baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

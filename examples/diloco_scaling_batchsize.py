@@ -1,0 +1,71 @@
+"""DiLoCo batch-size scaling sweep (reference
+``example/diloco_scaling_batchsize.py`` parity): global batch × {1,2,4,8},
+DDP-vs-DiLoCo at K ∈ {1,2,4}, H=30, fixed token budget, lr scaled linearly
+with the batch multiplier (reference ``:74-129``)."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+import argparse
+import json
+
+from gym_tpu import Trainer
+from gym_tpu.data import get_dataset
+from gym_tpu.models.nanogpt import GPT, GPTConfig
+from gym_tpu.strategy import DiLoCoStrategy, OptimSpec, SimpleReduceStrategy
+
+BASE_BATCH = 16
+BASE_LR = 3e-4
+TOKEN_BUDGET = 2 ** 24  # scaled down from the reference's 2^28
+H = 30
+BLOCK_SIZE = 256
+
+
+def run(mult: int, num_nodes: int, use_diloco: bool):
+    ds, vocab = get_dataset("shakespeare", BLOCK_SIZE, end_pc=0.9)
+    val, _ = get_dataset("shakespeare", BLOCK_SIZE, start_pc=0.9)
+    cfg = GPTConfig.gpt2_size_map("small")
+    cfg.vocab_size = int(vocab)
+    cfg.block_size = BLOCK_SIZE
+
+    batch_size = BASE_BATCH * mult
+    lr = BASE_LR * mult  # linear lr scaling (reference :79, :104)
+    max_steps = max(1, TOKEN_BUDGET // (batch_size * BLOCK_SIZE * num_nodes))
+    if use_diloco:
+        strategy = DiLoCoStrategy(optim_spec=OptimSpec("adamw", lr=lr), H=H)
+    else:
+        strategy = SimpleReduceStrategy(OptimSpec("adamw", lr=lr))
+    res = Trainer(GPT(cfg), ds, val).fit(
+        max_steps=max_steps, strategy=strategy, num_nodes=num_nodes,
+        batch_size=batch_size, val_size=64, val_interval=200,
+        run_name=f"scaling_m{mult}_k{num_nodes}_"
+                 f"{'diloco' if use_diloco else 'ddp'}",
+    )
+    return {"mult": mult, "num_nodes": num_nodes,
+            "strategy": "diloco" if use_diloco else "ddp",
+            "steps": res.steps, "final_loss": res.final_train_loss,
+            "it_s": res.steps_per_second}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--mults", type=int, nargs="+", default=[1, 2, 4, 8])
+    p.add_argument("--nodes", type=int, nargs="+", default=[1, 2, 4])
+    args = p.parse_args()
+    results = []
+    for mult in args.mults:
+        results.append(run(mult, 1, use_diloco=False))  # DDP baseline
+        for k in args.nodes:
+            results.append(run(mult, k, use_diloco=True))
+        print(json.dumps(results[-1]))
+    with open("logs/scaling_results.json", "w") as f:
+        json.dump(results, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,102 @@
+"""MNIST example (reference ``example/mnist.py`` parity).
+
+Trains the 2-block CNN with SPARTA on 2 simulated nodes, batch size 256 —
+the exact configuration behind the reference's published benchmark table
+(``README.md:104-112``, BASELINE.md). Data: torchvision MNIST when a local
+copy exists (this environment has no network egress), otherwise a
+deterministic synthetic stand-in with the same shapes.
+
+Run: ``python examples/mnist.py [--strategy sparta] [--num_nodes 2]``
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+import argparse
+
+import numpy as np
+
+from gym_tpu import Trainer
+from gym_tpu.data import ArrayDataset
+from gym_tpu.models import MnistLossModel
+from gym_tpu.strategy import (DeMoStrategy, DiLoCoStrategy, FedAvgStrategy,
+                              OptimSpec, SimpleReduceStrategy, SPARTAStrategy)
+
+
+def load_mnist(train: bool):
+    """torchvision MNIST with RandomAffine-equivalent augmentation left to
+    the caller (reference ``example/mnist.py:14-27``); falls back to a
+    synthetic digit-blob dataset offline."""
+    try:
+        from torchvision import datasets, transforms  # noqa
+
+        ds = datasets.MNIST("data", train=train, download=False)
+        imgs = (ds.data.numpy().astype(np.float32) / 255.0 - 0.1307) / 0.3081
+        imgs = imgs[..., None]
+        labels = ds.targets.numpy().astype(np.int32)
+        return ArrayDataset(imgs, labels)
+    except Exception:
+        n = 8192 if train else 1024
+        rng = np.random.default_rng(0 if train else 1)
+        labels = rng.integers(0, 10, size=n).astype(np.int32)
+        imgs = rng.normal(0, 0.1, size=(n, 28, 28, 1)).astype(np.float32)
+        for i, y in enumerate(labels):
+            imgs[i, (y * 2): (y * 2 + 6), 8:20, 0] += 1.2
+        print("[examples/mnist] torchvision MNIST unavailable -> synthetic")
+        return ArrayDataset(imgs, labels)
+
+
+def make_strategy(name: str, lr: float):
+    optim = OptimSpec("adam", lr=lr)
+    sched = dict(lr_scheduler="lambda_cosine",
+                 lr_scheduler_kwargs={"warmup_steps": 100})
+    return {
+        "simple_reduce": lambda: SimpleReduceStrategy(optim, **sched),
+        "sparta": lambda: SPARTAStrategy(optim, p_sparta=0.005, **sched),
+        "diloco": lambda: DiLoCoStrategy(optim, H=100, **sched),
+        "fedavg": lambda: FedAvgStrategy(optim, H=100, **sched),
+        "demo": lambda: DeMoStrategy(
+            optim_spec=OptimSpec("sgd", lr=lr),
+            compression_decay=0.999, compression_topk=32,
+            compression_chunk=64, **sched),
+    }[name]()
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--strategy", default="sparta",
+                   choices=["simple_reduce", "sparta", "diloco", "fedavg",
+                            "demo"])
+    p.add_argument("--num_nodes", type=int, default=2)
+    p.add_argument("--num_epochs", type=int, default=1)
+    p.add_argument("--max_steps", type=int, default=None)
+    p.add_argument("--batch_size", type=int, default=256)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--device", default=None)
+    p.add_argument("--wandb_project", default=None)
+    args = p.parse_args()
+
+    trainer = Trainer(MnistLossModel(), load_mnist(True), load_mnist(False))
+    res = trainer.fit(
+        num_epochs=args.num_epochs,
+        max_steps=args.max_steps,
+        strategy=make_strategy(args.strategy, args.lr),
+        num_nodes=args.num_nodes,
+        device=args.device,
+        batch_size=args.batch_size,
+        val_size=256,
+        val_interval=100,
+        wandb_project=args.wandb_project,
+        run_name=f"mnist_{args.strategy}_{args.num_nodes}n",
+    )
+    print(f"final train loss {res.final_train_loss:.4f} "
+          f"({res.steps_per_second:.2f} it/s)")
+
+
+if __name__ == "__main__":
+    main()

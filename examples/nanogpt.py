@@ -1,0 +1,200 @@
+"""nanoGPT CLI (reference ``example/nanogpt.py`` parity).
+
+Full flag surface of the reference (SURVEY §5.6): dataset/pc-range/
+block_size (``:36-47``), training/model-size (``:49-58``), optimization
+(``:61-67``), seed/wandb/val (``:69-74``), ``--strategy`` choice (``:77-83``)
+and per-strategy knobs — FedAvg ``--H --island_size`` (``:85-92``), SPARTA
+``--p_sparta --sparta_interval`` (``:93-102``; unlike the reference these
+flags are actually consumed), DiLoCo ``--diloco_interval --outer_lr
+--nesterov --outer_momentum`` (``:104-116``), DeMo compression flags
+(``:118-133``). The ``diloco_sparta`` combo works here (the reference ships
+it broken — SURVEY §2.1).
+
+TPU-native additions: ``--cp`` (context-parallel devices per node, ring
+attention) and ``--attn_impl`` (dense/flash/ring).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+import argparse
+
+import numpy as np
+
+from gym_tpu import Trainer
+from gym_tpu.data import ContiguousGPTTrainDataset, get_dataset
+from gym_tpu.models.nanogpt import GPT, GPTConfig
+from gym_tpu.strategy import (DeMoStrategy, DiLoCoStrategy, FedAvgStrategy,
+                              OptimSpec, SimpleReduceStrategy,
+                              SPARTADiLoCoStrategy, SPARTAStrategy)
+
+
+def gen_run_name(args) -> str:
+    """Run-name generator (reference ``example/nanogpt.py:9-28``)."""
+    parts = [args.dataset, args.model_size, args.strategy,
+             f"{args.num_nodes}n", f"bs{args.batch_size}"]
+    if args.strategy in ("diloco", "diloco_sparta"):
+        parts.append(f"H{args.diloco_interval}")
+    if args.strategy in ("sparta", "diloco_sparta"):
+        parts.append(f"p{args.p_sparta}")
+    return "_".join(str(p) for p in parts)
+
+
+def create_strategy(args):
+    """Strategy factory (reference ``example/nanogpt.py:138-245``)."""
+    optim = OptimSpec("adamw", lr=args.lr)
+    sched = dict(
+        lr_scheduler="lambda_cosine",
+        lr_scheduler_kwargs={
+            "warmup_steps": args.warmup_steps,
+            "cosine_anneal": args.cosine_anneal,
+        },
+        max_norm=args.max_norm,
+    )
+    if args.strategy == "base":
+        return SimpleReduceStrategy(optim_spec=optim, **sched)
+    if args.strategy == "fedavg":
+        return FedAvgStrategy(inner_optim=optim, H=args.H,
+                              island_size=args.island_size, **sched)
+    if args.strategy == "diloco":
+        return DiLoCoStrategy(
+            optim_spec=optim,
+            outer_optim_spec=OptimSpec(
+                "sgd", lr=args.outer_lr, nesterov=args.nesterov,
+                momentum=args.outer_momentum),
+            H=args.diloco_interval, **sched)
+    if args.strategy == "sparta":
+        return SPARTAStrategy(inner_optim=optim, p_sparta=args.p_sparta,
+                              interval=args.sparta_interval, **sched)
+    if args.strategy == "diloco_sparta":
+        return SPARTADiLoCoStrategy(
+            optim_spec=optim,
+            outer_optim_spec=OptimSpec(
+                "sgd", lr=args.outer_lr, nesterov=args.nesterov,
+                momentum=args.outer_momentum),
+            p_sparta=args.p_sparta, H=args.diloco_interval,
+            sparta_interval=args.sparta_interval, **sched)
+    if args.strategy == "demo":
+        return DeMoStrategy(
+            optim_spec=OptimSpec("sgd", lr=args.lr),
+            compression_decay=args.compression_decay,
+            compression_topk=args.compression_topk,
+            compression_chunk=args.compression_chunk,
+            weight_decay=args.weight_decay, **sched)
+    raise ValueError(f"unknown strategy {args.strategy}")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    # dataset (reference :36-47)
+    p.add_argument("--dataset", default="shakespeare",
+                   choices=["shakespeare", "wikitext", "code", "owt"])
+    p.add_argument("--start_pc", type=float, default=0.0)
+    p.add_argument("--end_pc", type=float, default=1.0)
+    p.add_argument("--block_size", type=int, default=1024)
+    # training / model size (:49-58)
+    p.add_argument("--num_nodes", type=int, default=1)
+    p.add_argument("--device", default=None)
+    p.add_argument("--model_size", default="small",
+                   choices=["small", "base", "medium", "large", "xl"])
+    p.add_argument("--num_epochs", type=int, default=1)
+    p.add_argument("--max_steps", type=int, default=None)
+    p.add_argument("--batch_size", type=int, default=16)
+    p.add_argument("--minibatch_size", type=int, default=None)
+    # optimization (:61-67)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--max_norm", type=float, default=1.0)
+    p.add_argument("--warmup_steps", type=int, default=100)
+    p.add_argument("--cosine_anneal", action="store_true")
+    p.add_argument("--weight_decay", type=float, default=0.1)
+    # bookkeeping (:69-74)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--wandb_project", default=None)
+    p.add_argument("--val_size", type=int, default=256)
+    p.add_argument("--val_interval", type=int, default=100)
+    # strategy (:77-133)
+    p.add_argument("--strategy", default="base",
+                   choices=["base", "fedavg", "diloco", "sparta",
+                            "diloco_sparta", "demo"])
+    p.add_argument("--H", type=int, default=1)
+    p.add_argument("--island_size", type=int, default=None)
+    p.add_argument("--p_sparta", type=float, default=0.005)
+    p.add_argument("--sparta_interval", type=int, default=1)
+    p.add_argument("--diloco_interval", type=int, default=100)
+    p.add_argument("--outer_lr", type=float, default=0.7)
+    p.add_argument("--nesterov",
+                   type=lambda s: s.lower() in ("1", "true", "yes"),
+                   default=True)
+    p.add_argument("--outer_momentum", type=float, default=0.9)
+    p.add_argument("--compression_decay", type=float, default=0.999)
+    p.add_argument("--compression_topk", type=int, default=32)
+    p.add_argument("--compression_chunk", type=int, default=64)
+    # TPU-native additions
+    p.add_argument("--cp", type=int, default=1,
+                   help="context-parallel devices per node (ring attention)")
+    p.add_argument("--attn_impl", default=None,
+                   choices=[None, "dense", "flash", "ring"])
+    p.add_argument("--autocast", action="store_true",
+                   help="bf16 forward pass")
+    args = p.parse_args()
+
+    attn = args.attn_impl or ("ring" if args.cp > 1 else "dense")
+
+    # dataset factory: per-node OWT shard convention
+    # (reference example/nanogpt.py:253-281)
+    if args.dataset == "owt":
+        def factory(rank, num_nodes, is_val):
+            if is_val:
+                ds, _ = get_dataset("owt", args.block_size,
+                                    start_pc=0.99, end_pc=1.0)
+                return ds
+            width = 0.99 / num_nodes
+            ds, _ = get_dataset(
+                "owt", args.block_size,
+                start_pc=args.start_pc + rank * width,
+                end_pc=args.start_pc + (rank + 1) * width)
+            return ds
+        train_data, val_data = factory, factory
+        _, vocab_size = get_dataset("owt", args.block_size,
+                                    start_pc=0.0, end_pc=0.001)
+    else:
+        ds, vocab_size = get_dataset(args.dataset, args.block_size,
+                                     start_pc=args.start_pc,
+                                     end_pc=args.end_pc * 0.9)
+        val, _ = get_dataset(args.dataset, args.block_size,
+                             start_pc=args.end_pc * 0.9, end_pc=args.end_pc)
+        train_data, val_data = ds, val
+
+    cfg = GPTConfig.gpt2_size_map(args.model_size)
+    cfg.vocab_size = int(vocab_size)
+    cfg.block_size = args.block_size
+    cfg.attn_impl = attn
+    cfg.seq_axis = "seq" if attn == "ring" else None
+
+    res = Trainer(GPT(cfg), train_data, val_data).fit(
+        num_epochs=args.num_epochs,
+        max_steps=args.max_steps,
+        strategy=create_strategy(args),
+        num_nodes=args.num_nodes,
+        device=args.device,
+        batch_size=args.batch_size,
+        minibatch_size=args.minibatch_size,
+        cp=args.cp,
+        autocast=args.autocast,
+        seed=args.seed,
+        val_size=args.val_size,
+        val_interval=args.val_interval,
+        wandb_project=args.wandb_project,
+        run_name=gen_run_name(args),
+    )
+    print(f"final train loss {res.final_train_loss:.4f} "
+          f"({res.steps_per_second:.2f} it/s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""Minimal DiLoCo playground (reference ``example/playground.py`` parity):
+4 simulated nodes, 8L/8H/512 GPT on OWT (synthetic fallback offline)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+
+from gym_tpu import Trainer
+from gym_tpu.data import get_dataset
+from gym_tpu.models.nanogpt import GPT, GPTConfig
+from gym_tpu.strategy import DiLoCoStrategy, OptimSpec
+
+NUM_NODES = 4
+BLOCK_SIZE = 1024
+
+
+def dataset_factory(rank, num_nodes, is_val):
+    if is_val:
+        ds, _ = get_dataset("owt", BLOCK_SIZE, start_pc=0.99, end_pc=1.0)
+        return ds
+    width = 0.99 / num_nodes
+    ds, _ = get_dataset("owt", BLOCK_SIZE, start_pc=rank * width,
+                        end_pc=(rank + 1) * width)
+    return ds
+
+
+def main():
+    _, vocab_size = get_dataset("owt", BLOCK_SIZE, start_pc=0.0, end_pc=0.001)
+    cfg = GPTConfig(block_size=BLOCK_SIZE, vocab_size=int(vocab_size),
+                    n_layer=8, n_head=8, n_embd=512, dropout=0.0)
+    res = Trainer(GPT(cfg), dataset_factory, dataset_factory).fit(
+        max_steps=1000,
+        strategy=DiLoCoStrategy(
+            optim_spec=OptimSpec("adamw", lr=3e-4), H=100,
+            lr_scheduler="lambda_cosine",
+            lr_scheduler_kwargs={"warmup_steps": 100}),
+        num_nodes=NUM_NODES,
+        batch_size=16,
+        val_size=64,
+        val_interval=100,
+        run_name="playground_diloco",
+    )
+    print(f"final loss {res.final_train_loss:.4f}")
+
+
+if __name__ == "__main__":
+    main()

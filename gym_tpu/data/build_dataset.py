@@ -83,12 +83,12 @@ def _try_hf_small(dataset: str, start_pc: float, end_pc: float):
     try:
         from datasets import concatenate_datasets, load_dataset
 
-        name, conf = (("tiny_shakespeare", None) if dataset == "shakespeare"
-                      else ("wikitext", "wikitext-103-v1"))
         if dataset == "shakespeare":
             raw = load_dataset("Trelis/tiny-shakespeare")
+        elif dataset == "code":
+            raw = load_dataset("codeparrot/codeparrot-clean-valid")
         else:
-            raw = load_dataset(name, conf)
+            raw = load_dataset("wikitext", "wikitext-103-v1")
         parts = [raw[s] for s in raw.keys()]
         ds = concatenate_datasets(parts)
         n = len(ds)
@@ -120,7 +120,9 @@ def build_dataset_small(
     start_pc: float = 0.0, end_pc: float = 1.0,
     data_root: str = "data",
 ) -> Tuple[np.ndarray, int]:
-    assert dataset in ("shakespeare", "wikitext")
+    # "code" = BPE stream like wikitext, sourced from a code corpus
+    # (reference example/nanogpt.py offers the same dataset choice)
+    assert dataset in ("shakespeare", "wikitext", "code")
     char = dataset == "shakespeare"
     cache_dir = os.path.join(data_root,
                              f"{dataset}_char" if char else dataset)
@@ -136,7 +138,11 @@ def build_dataset_small(
     if data is None:
         span = max(1e-6, end_pc - start_pc)
         n = int(2_000_000 * span) if char else int(1_000_000 * span)
-        seed = hash((dataset, round(start_pc, 6), round(end_pc, 6))) % (2**31)
+        # stable across processes (Python hash() is salted per process)
+        import zlib
+        seed = zlib.crc32(
+            f"{dataset}:{round(start_pc, 6)}:{round(end_pc, 6)}".encode()
+        ) % (2**31)
         data = (_synthetic_char_stream(n, seed) if char
                 else _synthetic_bpe_stream(n, seed))
     np.save(cache, data)

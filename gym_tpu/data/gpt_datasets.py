@@ -24,7 +24,7 @@ import numpy as np
 
 class ContiguousGPTTrainDataset:
     def __init__(self, data: np.ndarray, block_size: int):
-        data = np.asarray(data)
+        data = np.ascontiguousarray(np.asarray(data))
         assert data.ndim == 1
         self.data = data
         self.block_size = int(block_size)
@@ -33,9 +33,11 @@ class ContiguousGPTTrainDataset:
         return max(0, self.data.shape[0] - self.block_size - 1)
 
     def take(self, idx: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-        idx = np.asarray(idx)
-        win = self.data[idx[:, None] + np.arange(self.block_size + 1)]
-        return win[:, :-1].astype(np.int32), win[:, 1:].astype(np.int32)
+        # fused widen-and-copy in native C++ when available (threaded),
+        # numpy fancy-indexing otherwise — identical output either way
+        from ..native import gather_windows
+
+        return gather_windows(self.data, np.asarray(idx), self.block_size)
 
     def __getitem__(self, i: int):
         x, y = self.take(np.array([i]))

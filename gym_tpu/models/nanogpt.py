@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ..ops.attention import dense_causal_attention
+from ..ops.attention import causal_attention
 
 
 @dataclasses.dataclass
@@ -39,6 +39,11 @@ class GPTConfig:
     n_embd: int = 768
     dropout: float = 0.0
     bias: bool = True
+    # Attention backend: 'dense' (reference behavior), 'flash' (Pallas TPU
+    # kernel), or 'ring' (context-parallel over the `seq_axis` mesh axis —
+    # long-context support the reference lacks, SURVEY §5.7).
+    attn_impl: str = "dense"
+    seq_axis: Optional[str] = None
 
     @classmethod
     def gpt2_size_map(cls, size: str) -> "GPTConfig":
@@ -93,8 +98,9 @@ class CausalSelfAttention(nn.Module):
             return z.reshape(b, t, cfg.n_head, hd).transpose(0, 2, 1, 3)
 
         rng = self.make_rng("dropout") if (train and cfg.dropout > 0) else None
-        y = dense_causal_attention(
+        y = causal_attention(
             heads(q), heads(k), heads(v),
+            impl=cfg.attn_impl, seq_axis=cfg.seq_axis,
             dropout_rate=cfg.dropout, dropout_rng=rng,
             deterministic=not train,
         )
@@ -139,7 +145,15 @@ class Block(nn.Module):
 
 class GPT(nn.Module):
     """``__call__(batch, train)``: a ``(idx, targets)`` tuple → scalar loss
-    (targets == -1 are ignored); a bare ``idx`` array → logits [B, T, V]."""
+    (targets == -1 are ignored); a bare ``idx`` array → logits [B, T, V].
+
+    When ``config.seq_axis`` is set the model is context-parallel: it must
+    run under ``shard_map`` with that mesh axis, each device receives the
+    FULL batch, slices its own sequence chunk, attends via ring attention,
+    and the returned loss is the global mean (psum over the seq axis) —
+    replicated across the group. Bare-``idx`` calls return the local chunk's
+    logits.
+    """
 
     config: GPTConfig
 
@@ -154,11 +168,28 @@ class GPT(nn.Module):
         assert t <= cfg.block_size, (
             f"sequence length {t} > block_size {cfg.block_size}"
         )
+        if cfg.seq_axis is not None:
+            # chunked sequences only see their own K/V under dense/flash —
+            # block-diagonal attention that would train silently wrong
+            assert cfg.attn_impl == "ring", (
+                f"seq_axis requires attn_impl='ring', got {cfg.attn_impl!r}"
+            )
+            cp = jax.lax.axis_size(cfg.seq_axis)
+            assert t % cp == 0, f"seq len {t} not divisible by cp={cp}"
+            tl = t // cp
+            chunk = jax.lax.axis_index(cfg.seq_axis)
+            idx = jax.lax.dynamic_slice_in_dim(idx, chunk * tl, tl, axis=1)
+            if targets is not None:
+                targets = jax.lax.dynamic_slice_in_dim(
+                    targets, chunk * tl, tl, axis=1
+                )
+            pos = chunk * tl + jnp.arange(tl)[None, :]
+        else:
+            pos = jnp.arange(t)[None, :]
         wte = nn.Embed(cfg.vocab_size, cfg.n_embd,
                        embedding_init=_init_normal(0.02), name="wte")
         wpe = nn.Embed(cfg.block_size, cfg.n_embd,
                        embedding_init=_init_normal(0.02), name="wpe")
-        pos = jnp.arange(t)[None, :]
         x = wte(idx) + wpe(pos)
         x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
         for i in range(cfg.n_layer):
@@ -174,7 +205,12 @@ class GPT(nn.Module):
             jnp.maximum(targets.reshape(-1), 0),
         )
         valid = (targets.reshape(-1) >= 0).astype(jnp.float32)
-        return jnp.sum(losses * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+        loss_sum = jnp.sum(losses * valid)
+        count = jnp.sum(valid)
+        if cfg.seq_axis is not None:
+            loss_sum = jax.lax.psum(loss_sum, cfg.seq_axis)
+            count = jax.lax.psum(count, cfg.seq_axis)
+        return loss_sum / jnp.maximum(count, 1.0)
 
 
 # -- model utilities (reference parity helpers) ----------------------------

@@ -1,0 +1,118 @@
+"""Native (C++) runtime components, reached via ctypes.
+
+The reference is pure Python and reaches native code only through torch's
+bundled backends (SURVEY §2.2). Here the TPU compute path is XLA/Pallas and
+the *host* runtime hot spots are native C++: currently the batch-assembly
+window gather for token streams (``window_gather.cpp``).
+
+The shared library is compiled on first import with the system ``g++``
+(cached next to the source, keyed by source hash) — no pybind11/setuptools
+machinery, just a C ABI + ctypes. Everything degrades gracefully to numpy
+when a compiler is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sys
+import tempfile
+from typing import Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "window_gather.cpp")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    with open(_SRC, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache_dir = os.environ.get(
+        "GYM_TPU_NATIVE_CACHE",
+        os.path.join(tempfile.gettempdir(), "gym_tpu_native"),
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    so_path = os.path.join(cache_dir, f"window_gather_{tag}.so")
+    if not os.path.exists(so_path):
+        tmp = so_path + f".tmp{os.getpid()}"
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+               _SRC, "-o", tmp]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, so_path)
+        except Exception as e:  # compiler missing / failed — numpy fallback
+            print(f"[gym_tpu.native] build failed ({e}); using numpy path",
+                  file=sys.stderr)
+            return None
+    try:
+        lib = ctypes.CDLL(so_path)
+    except OSError:
+        return None
+    i64 = ctypes.c_int64
+    p = ctypes.POINTER
+    for name, src_t in (("gather_windows_u16", ctypes.c_uint16),
+                        ("gather_windows_i32", ctypes.c_int32),
+                        ("gather_windows_u8", ctypes.c_uint8)):
+        fn = getattr(lib, name)
+        fn.argtypes = [p(src_t), p(i64), i64, i64,
+                       p(ctypes.c_int32), p(ctypes.c_int32), i64]
+        fn.restype = None
+    return lib
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if not _tried:
+        _tried = True
+        if os.environ.get("GYM_TPU_DISABLE_NATIVE"):
+            _lib = None
+        else:
+            _lib = _build_and_load()
+    return _lib
+
+
+_FN_BY_DTYPE = {
+    np.dtype(np.uint16): ("gather_windows_u16", ctypes.c_uint16),
+    np.dtype(np.int32): ("gather_windows_i32", ctypes.c_int32),
+    np.dtype(np.uint8): ("gather_windows_u8", ctypes.c_uint8),
+}
+
+
+def native_available(dtype) -> bool:
+    return np.dtype(dtype) in _FN_BY_DTYPE and _get_lib() is not None
+
+
+def gather_windows(
+    src: np.ndarray, idx: np.ndarray, window: int,
+    n_threads: Optional[int] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Fused (x, y) next-token window gather: ``x[r] = src[i:i+W]``,
+    ``y[r] = src[i+1:i+W+1]`` as int32. Native when possible, numpy
+    otherwise — identical results either way."""
+    lib = _get_lib()
+    key = np.dtype(src.dtype)
+    if lib is None or key not in _FN_BY_DTYPE or not src.flags.c_contiguous:
+        win = src[np.asarray(idx)[:, None] + np.arange(window + 1)]
+        return win[:, :-1].astype(np.int32), win[:, 1:].astype(np.int32)
+    name, src_t = _FN_BY_DTYPE[key]
+    idx = np.ascontiguousarray(idx, np.int64)
+    count = len(idx)
+    x = np.empty((count, window), np.int32)
+    y = np.empty((count, window), np.int32)
+    if n_threads is None:
+        n_threads = min(8, os.cpu_count() or 1)
+    getattr(lib, name)(
+        src.ctypes.data_as(ctypes.POINTER(src_t)),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        count, window,
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        y.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        n_threads,
+    )
+    return x, y

@@ -1,0 +1,75 @@
+// Native batch assembly for token-stream datasets.
+//
+// The per-step host work of the GPT data path is a sliding-window gather:
+// for each sampled start index i, copy src[i : i+T] into x and
+// src[i+1 : i+T+1] into y (the reference does this per-row in Python,
+// `example/nanogpt/gpt_dataset.py:134-153`; our numpy path does it with
+// fancy indexing + two astype copies). At 64 simulated nodes this is the
+// largest host-side cost between device steps, so it is implemented here as
+// a single fused widen-and-copy pass, threaded over rows.
+//
+// Built by gym_tpu.native at first import (g++ -O3 -shared); reached via
+// ctypes — no pybind11 dependency.
+
+#include <cstdint>
+#include <thread>
+#include <vector>
+
+namespace {
+
+template <typename SrcT>
+void gather_rows(const SrcT* src, const int64_t* idx, int64_t row_begin,
+                 int64_t row_end, int64_t window, int32_t* x, int32_t* y) {
+  for (int64_t r = row_begin; r < row_end; ++r) {
+    const SrcT* base = src + idx[r];
+    int32_t* xr = x + r * window;
+    int32_t* yr = y + r * window;
+    for (int64_t j = 0; j < window; ++j) {
+      xr[j] = static_cast<int32_t>(base[j]);
+      yr[j] = static_cast<int32_t>(base[j + 1]);
+    }
+  }
+}
+
+template <typename SrcT>
+void gather_windows(const SrcT* src, const int64_t* idx, int64_t count,
+                    int64_t window, int32_t* x, int32_t* y,
+                    int64_t n_threads) {
+  if (n_threads <= 1 || count < 64) {
+    gather_rows(src, idx, 0, count, window, x, y);
+    return;
+  }
+  std::vector<std::thread> workers;
+  const int64_t per = (count + n_threads - 1) / n_threads;
+  for (int64_t t = 0; t < n_threads; ++t) {
+    const int64_t lo = t * per;
+    const int64_t hi = std::min(count, lo + per);
+    if (lo >= hi) break;
+    workers.emplace_back(gather_rows<SrcT>, src, idx, lo, hi, window, x, y);
+  }
+  for (auto& w : workers) w.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+void gather_windows_u16(const uint16_t* src, const int64_t* idx,
+                        int64_t count, int64_t window, int32_t* x, int32_t* y,
+                        int64_t n_threads) {
+  gather_windows(src, idx, count, window, x, y, n_threads);
+}
+
+void gather_windows_i32(const int32_t* src, const int64_t* idx, int64_t count,
+                        int64_t window, int32_t* x, int32_t* y,
+                        int64_t n_threads) {
+  gather_windows(src, idx, count, window, x, y, n_threads);
+}
+
+void gather_windows_u8(const uint8_t* src, const int64_t* idx, int64_t count,
+                       int64_t window, int32_t* x, int32_t* y,
+                       int64_t n_threads) {
+  gather_windows(src, idx, count, window, x, y, n_threads);
+}
+
+}  // extern "C"

@@ -44,3 +44,43 @@ def dense_causal_attention(
         probs = probs * keep / (1.0 - dropout_rate)
     probs = probs.astype(v.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def causal_attention(
+    q: jnp.ndarray,  # [B, H, T(local), D]
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    impl: str = "dense",
+    seq_axis: Optional[str] = None,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
+    deterministic: bool = True,
+) -> jnp.ndarray:
+    """Dispatch to an attention implementation.
+
+    - ``'dense'``  — single-device XLA attention (reference behavior).
+    - ``'ring'``   — context-parallel ring attention; requires ``seq_axis``
+      (a mesh axis the sequence is sharded over) and must be called under
+      ``shard_map``.
+    - ``'flash'``  — Pallas TPU flash-attention kernel (falls back to dense
+      off-TPU).
+    """
+    if impl == "ring":
+        from ..parallel.ring_attention import ring_causal_attention
+        assert seq_axis is not None, "ring attention needs seq_axis"
+        return ring_causal_attention(
+            q, k, v, axis_name=seq_axis, dropout_rate=dropout_rate,
+            dropout_rng=dropout_rng, deterministic=deterministic,
+        )
+    if impl == "flash":
+        from .flash_attention import flash_causal_attention
+        return flash_causal_attention(
+            q, k, v, dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+            deterministic=deterministic,
+        )
+    assert impl == "dense", f"unknown attention impl {impl!r}"
+    return dense_causal_attention(
+        q, k, v, dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+        deterministic=deterministic,
+    )

@@ -1,0 +1,61 @@
+"""Flash attention on TPU via Pallas.
+
+The reference gets flash attention from torch
+``F.scaled_dot_product_attention`` when available
+(``example/nanogpt/nanogpt.py:78-87``). The TPU-native equivalent is a
+Pallas kernel: blockwise online-softmax attention that never materializes
+the [T, T] score matrix in HBM. We use JAX's bundled Pallas TPU kernel
+(``jax.experimental.pallas.ops.tpu.flash_attention``, fwd+bwd defined) and
+fall back to the dense XLA path on CPU/GPU or for shapes the kernel does not
+tile well (T < 128, unaligned head dims).
+
+Attention dropout is not supported by the kernel (same situation as torch's
+flash backend, which silently picks a different kernel when dropout > 0) —
+we fall back to dense in that case too.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .attention import dense_causal_attention
+
+
+@functools.cache
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _flash_ok(q: jnp.ndarray) -> bool:
+    t, d = q.shape[-2], q.shape[-1]
+    # kernel tiles: sequence in ≥128 blocks, head_dim on 128 lanes
+    return t >= 128 and t % 128 == 0 and d <= 256
+
+
+def flash_causal_attention(
+    q: jnp.ndarray,  # [B, H, T, D]
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
+    deterministic: bool = True,
+) -> jnp.ndarray:
+    use_dropout = dropout_rate > 0.0 and not deterministic
+    if not _on_tpu() or use_dropout or not _flash_ok(q):
+        return dense_causal_attention(
+            q, k, v, dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+            deterministic=deterministic,
+        )
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention,
+    )
+    scale = 1.0 / float(q.shape[-1]) ** 0.5
+    return flash_attention(q, k, v, causal=True, sm_scale=scale)

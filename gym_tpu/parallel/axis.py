@@ -28,6 +28,7 @@ PyTree = Any
 
 NODE_AXIS = "node"
 VNODE_AXIS = "vnode"
+SEQ_AXIS = "seq"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -45,6 +46,11 @@ class AxisCtx:
     axes: tuple = (NODE_AXIS, VNODE_AXIS)
     # Size of each axis, same order as `axes`. prod(sizes) == num_nodes.
     sizes: tuple = (1, 1)
+    # Context-parallel (sequence) mesh axes, orthogonal to the node axes.
+    # Long sequences are sharded over these inside each node's forward pass
+    # (ring attention); gradients must be psum'd over them (train_node.py).
+    seq_axes: tuple = ()
+    seq_sizes: tuple = ()
 
     # -- collectives ------------------------------------------------------
 
@@ -101,6 +107,30 @@ class AxisCtx:
     def ppermute(self, tree: PyTree, perm: Sequence[tuple]) -> PyTree:
         """Ring-style permute across the *outer* (physical) node axis only."""
         return jax.tree.map(lambda x: lax.ppermute(x, self.axes[0], perm), tree)
+
+    # -- context-parallel (sequence) axis ---------------------------------
+
+    @property
+    def cp(self) -> int:
+        """Context-parallel group size (1 = no sequence sharding)."""
+        n = 1
+        for s in self.seq_sizes:
+            n *= s
+        return n
+
+    def seq_psum(self, tree: PyTree) -> PyTree:
+        """Sum over the context-parallel axes (used to combine the per-chunk
+        gradient contributions of a sequence-sharded forward pass)."""
+        if not self.seq_axes:
+            return tree
+        return jax.tree.map(lambda x: lax.psum(x, self.seq_axes), tree)
+
+    def seq_index(self) -> jnp.ndarray:
+        """Linear index of this device within its context-parallel group."""
+        idx = jnp.zeros((), jnp.int32)
+        for name, size in zip(self.seq_axes, self.seq_sizes):
+            idx = idx * size + lax.axis_index(name)
+        return idx
 
 
 def single_node_ctx() -> AxisCtx:

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .axis import NODE_AXIS, VNODE_AXIS, AxisCtx
+from .axis import NODE_AXIS, SEQ_AXIS, VNODE_AXIS, AxisCtx
 
 PyTree = Any
 
@@ -45,21 +45,35 @@ class NodeRuntime:
     n_phys: int   # P — physical devices carrying the 'node' mesh axis
     n_virt: int   # V — simulated nodes folded per device (vmap)
     ctx: AxisCtx
+    cp: int = 1   # context-parallel group size (devices per 'seq' axis)
 
     @classmethod
-    def create(cls, num_nodes: int, devices: Sequence[jax.Device] | None = None):
+    def create(cls, num_nodes: int,
+               devices: Sequence[jax.Device] | None = None, cp: int = 1):
+        """``cp > 1`` adds a ``'seq'`` mesh axis: each simulated node's
+        forward pass is context-parallel over ``cp`` devices (ring attention
+        over ICI, SURVEY §5.7 resolution). Mesh is [P, cp]; P·cp ≤ devices."""
         if devices is None:
             devices = jax.devices()
-        n_phys = _largest_divisor_at_most(num_nodes, len(devices))
+        assert len(devices) >= cp, (
+            f"cp={cp} does not fit {len(devices)} devices"
+        )
+        n_phys = _largest_divisor_at_most(num_nodes, len(devices) // cp)
         n_virt = num_nodes // n_phys
-        mesh = Mesh(np.asarray(devices[:n_phys]), (NODE_AXIS,))
+        if cp == 1:
+            mesh = Mesh(np.asarray(devices[:n_phys]), (NODE_AXIS,))
+        else:
+            grid = np.asarray(devices[: n_phys * cp]).reshape(n_phys, cp)
+            mesh = Mesh(grid, (NODE_AXIS, SEQ_AXIS))
         ctx = AxisCtx(
             num_nodes=num_nodes,
             axes=(NODE_AXIS, VNODE_AXIS),
             sizes=(n_phys, n_virt),
+            seq_axes=(SEQ_AXIS,) if cp > 1 else (),
+            seq_sizes=(cp,) if cp > 1 else (),
         )
         return cls(num_nodes=num_nodes, mesh=mesh, n_phys=n_phys,
-                   n_virt=n_virt, ctx=ctx)
+                   n_virt=n_virt, ctx=ctx, cp=cp)
 
     # -- sharding helpers -------------------------------------------------
 

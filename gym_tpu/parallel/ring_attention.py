@@ -1,0 +1,124 @@
+"""Ring attention: context-parallel causal attention over an ICI ring.
+
+The reference has NO long-context support — max context is one device's dense
+attention (``example/nanogpt/nanogpt.py:60-101``, SURVEY §5.7). This module
+is the TPU-native seat for long context: the sequence axis is sharded over a
+mesh axis (``'seq'``); each device holds a contiguous chunk of Q/K/V and the
+K/V chunks rotate around the ring via ``lax.ppermute`` while a
+flash-attention-style online softmax accumulates the output
+(Liu et al., Ring Attention with Blockwise Transformers, arXiv:2310.01889).
+
+Causality makes half the ring steps no-ops for a given pair; those blocks are
+masked (static control flow — XLA-friendly) rather than skipped. Peak memory
+per device is O(T/c · T/c) for one logits block instead of O(T²).
+
+Usable standalone under ``shard_map`` or through the
+``gym_tpu.ops.attention.causal_attention`` dispatcher (GPT models pick it up
+via ``GPTConfig.attn_impl = 'ring'`` + a ``seq`` mesh axis).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def _block_attend(q, k, v, mask, scale, dropout_rate=0.0, dropout_rng=None):
+    """One Q-chunk × K-chunk block: returns (scores·V, running max, denom).
+
+    q: [B, H, Tq, D]; k, v: [B, H, Tk, D]; mask: [Tq, Tk] bool.
+    All in f32 logits space (bf16 inputs fine — matmul accumulates f32).
+
+    Dropout matches dense attention semantics (drop *probabilities*, keep
+    the softmax denominator undropped): l accumulates the full p while the
+    numerator uses the dropped/rescaled p.
+    """
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    logits = jnp.where(mask[None, None], logits, NEG_INF)
+    m = jnp.max(logits, axis=-1, keepdims=True)          # [B,H,Tq,1]
+    # guard the all-masked row: exp(NEG_INF - NEG_INF) would be exp(0)=1
+    m_safe = jnp.maximum(m, -1e30)
+    p = jnp.exp(logits - m_safe)
+    p = jnp.where(mask[None, None], p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)               # [B,H,Tq,1]
+    p_num = p
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, p.shape)
+        p_num = p * keep / (1.0 - dropout_rate)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p_num.astype(v.dtype), v)
+    return o.astype(jnp.float32), m_safe, l
+
+
+def ring_causal_attention(
+    q: jnp.ndarray,  # [B, H, Tl, D] — local sequence chunk
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
+    deterministic: bool = True,
+) -> jnp.ndarray:
+    """Causal attention with the sequence sharded over ``axis_name``.
+
+    Device ``i`` owns global positions ``[i·Tl, (i+1)·Tl)``. K/V rotate
+    around the ring; an online softmax merges each incoming block, so the
+    result is bitwise-equivalent math to dense causal attention over the
+    full sequence (up to fp reassociation).
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    tl = q.shape[-2]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+
+    q_pos = my * tl + jnp.arange(tl)                      # [Tl] global
+
+    # ring permutation: chunk data moves i -> i+1 each step, so after r
+    # steps this device holds the chunk of (my - r) mod n.
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    drop_active = dropout_rate > 0.0 and not deterministic
+
+    def ring_step(carry, r):
+        o_acc, m_acc, l_acc, kc, vc = carry
+        src = (my - r) % n
+        k_pos = src * tl + jnp.arange(tl)
+        mask = q_pos[:, None] >= k_pos[None, :]           # causal [Tl, Tl]
+        blk_rng = (jax.random.fold_in(dropout_rng, r) if drop_active
+                   else None)
+        o_b, m_b, l_b = _block_attend(
+            q, kc, vc, mask, scale,
+            dropout_rate=dropout_rate if drop_active else 0.0,
+            dropout_rng=blk_rng,
+        )
+        # online softmax merge
+        m_new = jnp.maximum(m_acc, m_b)
+        a = jnp.exp(m_acc - m_new)
+        b = jnp.exp(m_b - m_new)
+        o_acc = o_acc * a + o_b * b
+        l_acc = l_acc * a + l_b * b
+        # rotate K/V to the next device (skipped result unused on last step,
+        # but static schedule keeps the collective uniform across devices)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (o_acc, m_new, l_acc, kc, vc), None
+
+    b_, h_, _, d_ = q.shape
+    # pvary: mark the fresh accumulators as device-varying over the ring
+    # axis so the scan carry type matches its output (shard_map VMA rule).
+    o0 = lax.pvary(jnp.zeros((b_, h_, tl, d_), jnp.float32), (axis_name,))
+    m0 = lax.pvary(jnp.full((b_, h_, tl, 1), -1e30, jnp.float32),
+                   (axis_name,))
+    l0 = lax.pvary(jnp.zeros((b_, h_, tl, 1), jnp.float32), (axis_name,))
+
+    (o, m, l, _, _), _ = lax.scan(
+        ring_step, (o0, m0, l0, k, v), jnp.arange(n)
+    )
+    out = o / jnp.maximum(l, 1e-30)
+    return out.astype(q.dtype)

@@ -64,6 +64,9 @@ def make_train_step(loss_model: LossModel, strategy: Strategy, ctx: AxisCtx):
 
     def node_step(state: TrainState, batch) -> Tuple[TrainState, Dict]:
         step_rng = jax.random.fold_in(state.rng, state.step)
+        if ctx.seq_axes:
+            # decorrelate dropout across a node's sequence chunks
+            step_rng = jax.random.fold_in(step_rng, ctx.seq_index())
         n_micro = jax.tree.leaves(batch)[0].shape[0]
 
         grad_fn = jax.value_and_grad(loss_model.loss, has_aux=True)
@@ -81,6 +84,10 @@ def make_train_step(loss_model: LossModel, strategy: Strategy, ctx: AxisCtx):
         (model_state, gsum, lsum, _), _ = jax.lax.scan(
             micro, (state.model_state, gzero, jnp.zeros(()), 0), batch
         )
+        # Context parallelism: a seq-sharded model returns the *global* loss
+        # (psum'd in-model) but each seq device's backward pass carries only
+        # its chunk's gradient contribution — combine them here.
+        gsum = ctx.seq_psum(gsum)
         grads = jax.tree.map(lambda g: g / n_micro, gsum)
         loss = lsum / n_micro
 

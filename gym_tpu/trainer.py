@@ -23,6 +23,7 @@ from .models.base import LossModel, as_loss_model
 from .parallel.mesh import NodeRuntime
 from .strategy.base import Strategy, tree_num_params
 from .train_node import make_eval_step, make_init_fn, make_train_step
+from .utils.checkpoint import CheckpointManager
 from .utils.logger import CSVLogger, Logger, WandbLogger
 
 PyTree = Any
@@ -79,6 +80,7 @@ class Trainer:
         val_size: int = 64,
         val_interval: int = 100,
         autocast: bool = False,
+        cp: int = 1,
         checkpoint_interval: Optional[int] = None,
         save_dir: Optional[str] = None,
         seed: int = 42,
@@ -101,8 +103,23 @@ class Trainer:
             import jax.numpy as jnp
             loss_model = LossModel(loss_model.module, jnp.bfloat16)
 
+        if cp > 1:
+            # A non-sequence-sharded model under cp>1 would compute the same
+            # full gradient on every seq device and seq_psum would scale it
+            # by cp — silently wrong optimization. Require the model to
+            # declare its sequence axis (GPTConfig.seq_axis convention).
+            mod = loss_model.module
+            seq_ax = getattr(mod, "seq_axis",
+                             getattr(getattr(mod, "config", None),
+                                     "seq_axis", None))
+            if seq_ax is None:
+                raise ValueError(
+                    "cp > 1 requires a sequence-sharded model: set "
+                    "seq_axis='seq' (and attn_impl='ring') on the model "
+                    "config, or drop the cp argument."
+                )
         runtime = NodeRuntime.create(
-            num_nodes, _resolve_devices(device, devices)
+            num_nodes, _resolve_devices(device, devices), cp=cp
         )
 
         train_dsets, train_sharded = resolve_node_datasets(
@@ -136,6 +153,17 @@ class Trainer:
         init_fn = make_init_fn(loss_model, strategy, example_micro, seed)
         state = runtime.init_state(init_fn)
 
+        # Checkpoint/resume (the reference's disabled subsystem, SURVEY
+        # §5.4, implemented for real): resume picks up device state, the
+        # data-iterator position, and the step counter.
+        ckpt = None
+        start_step = 0
+        if save_dir is not None and checkpoint_interval:
+            ckpt = CheckpointManager(save_dir, run_name or "default")
+            if ckpt.latest_step() is not None:
+                start_step, state, data_state, _ = ckpt.restore(state)
+                train_iter.load_state(data_state)
+
         train_step = runtime.compile(
             make_train_step(loss_model, strategy, runtime.ctx)
         )
@@ -150,7 +178,8 @@ class Trainer:
             "autocast": autocast,
             "model": type(loss_model.module).__name__,
             "num_params": None,  # filled below
-            "mesh": {"physical": runtime.n_phys, "virtual": runtime.n_virt},
+            "mesh": {"physical": runtime.n_phys, "virtual": runtime.n_virt,
+                     "cp": runtime.cp},
             **strategy.config(),
         }
 
@@ -191,6 +220,9 @@ class Trainer:
         pending = None  # (step_idx, metrics) — 1-step-lag fetch for overlap
         t_start = time.time()
         last_loss = float("nan")
+        logger.step = start_step
+        if getattr(logger, "pbar", None) is not None and start_step:
+            logger.pbar.update(start_step)
 
         def drain(p):
             nonlocal last_loss
@@ -203,7 +235,7 @@ class Trainer:
             history["train_loss"].append((step_idx, loss))
             history["comm_bytes"].append((step_idx, comm))
 
-        for step_idx in range(max_steps):
+        for step_idx in range(start_step, max_steps):
             if val_interval and step_idx % val_interval == 0:
                 if pending is not None:
                     drain(pending)
@@ -217,12 +249,18 @@ class Trainer:
                 drain(pending)
             pending = (step_idx, metrics)
             logger.increment_step()
+            if ckpt is not None and (step_idx + 1) % checkpoint_interval == 0:
+                ckpt.save(step_idx + 1, state, train_iter.state())
 
         if pending is not None:
             drain(pending)
         jax.block_until_ready(state.params)
         elapsed = time.time() - t_start
         run_eval()
+        if ckpt is not None:
+            if max_steps % checkpoint_interval != 0 and max_steps > start_step:
+                ckpt.save(max_steps, state, train_iter.state())
+            ckpt.close()
         logger.close()
 
         avg_params = runtime.average_over_nodes(state.params)
@@ -232,7 +270,9 @@ class Trainer:
             model_state=avg_model_state,
             node_state=state,
             steps=max_steps,
-            steps_per_second=max_steps / elapsed if elapsed > 0 else 0.0,
+            steps_per_second=(
+                (max_steps - start_step) / elapsed if elapsed > 0 else 0.0
+            ),
             final_train_loss=last_loss,
             history=history,
         )

@@ -1,0 +1,62 @@
+"""Checkpoint/resume (the reference's disabled subsystem, SURVEY §5.4).
+
+Oracle: training N steps straight produces the same final parameters as
+training k steps, "crashing", and resuming from the checkpoint for the
+remaining N−k — including the data-iterator position and per-node RNG, so
+the resumed run sees the exact same batch sequence.
+"""
+
+import shutil
+
+import jax
+import numpy as np
+
+from gym_tpu import Trainer
+from gym_tpu.data import ArrayDataset
+from gym_tpu.strategy import DiLoCoStrategy, OptimSpec
+
+from test_trainer_e2e import TinyLossModel, blobs
+
+
+def _fit(ds, max_steps, tmp, interval):
+    return Trainer(TinyLossModel(), ds, None).fit(
+        strategy=DiLoCoStrategy(optim_spec=OptimSpec("adamw", lr=1e-3), H=3),
+        num_nodes=4, max_steps=max_steps, batch_size=16, minibatch_size=8,
+        val_interval=0, show_progress=False, seed=11,
+        checkpoint_interval=interval, save_dir=tmp, run_name="ckpt_test",
+        log_dir="/tmp/gym_tpu_test_logs",
+    )
+
+
+def test_resume_matches_straight_run(tmp_path):
+    ds = blobs(256, seed=5)
+    straight_dir = str(tmp_path / "straight")
+    resume_dir = str(tmp_path / "resume")
+
+    res_straight = _fit(ds, 8, straight_dir, interval=100)  # never resumes
+
+    _fit(ds, 4, resume_dir, interval=4)       # stops at step 4, ckpt saved
+    res_resumed = _fit(ds, 8, resume_dir, interval=4)  # resumes 4 → 8
+
+    for a, b in zip(jax.tree.leaves(res_straight.params),
+                    jax.tree.leaves(res_resumed.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-5)
+    # resumed run only logged steps 4..7
+    steps = [s for s, _ in res_resumed.history["train_loss"]]
+    assert min(steps) == 4 and max(steps) == 7
+
+    shutil.rmtree(str(tmp_path), ignore_errors=True)
+
+
+def test_keep_latest_pruning(tmp_path):
+    ds = blobs(128, seed=6)
+    d = str(tmp_path / "prune")
+    _fit(ds, 6, d, interval=2)
+    from gym_tpu.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(d, "ckpt_test")
+    assert mgr.latest_step() == 6
+    assert len(mgr.manager.all_steps()) == 1  # max_to_keep=1 pruned the rest
+    mgr.close()
+    shutil.rmtree(str(tmp_path), ignore_errors=True)

@@ -1,0 +1,161 @@
+"""Ring attention / context parallelism (SURVEY §5.7 — capability the
+reference lacks; first-class here).
+
+Oracles: (1) the ring op is numerically identical to dense causal attention
+on the full sequence; (2) a context-parallel GPT training run produces the
+same losses and parameters as the same-seed dense run — sequence sharding is
+an execution detail, not a semantics change.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from gym_tpu import Trainer
+from gym_tpu.data import ArrayDataset
+from gym_tpu.models.nanogpt import GPT, GPTConfig
+from gym_tpu.ops.attention import dense_causal_attention
+from gym_tpu.ops.flash_attention import flash_causal_attention
+from gym_tpu.parallel.ring_attention import ring_causal_attention
+from gym_tpu.strategy import DiLoCoStrategy, OptimSpec, SimpleReduceStrategy
+
+
+def _shard_ring(q, k, v, n, devices):
+    mesh = Mesh(np.array(devices[:n]), ("seq",))
+    spec = P(None, None, "seq", None)
+
+    def f(q, k, v):
+        return ring_causal_attention(q, k, v, axis_name="seq")
+
+    return jax.jit(
+        jax.shard_map(f, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)
+    )(q, k, v)
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_ring_matches_dense(devices8, n):
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((2, 3, 64, 8)), jnp.float32)
+        for _ in range(3)
+    )
+    with jax.default_matmul_precision("highest"):
+        out = _shard_ring(q, k, v, n, devices8)
+        ref = dense_causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-6, rtol=1e-5)
+
+
+def test_ring_bf16(devices8):
+    rng = np.random.default_rng(1)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((1, 2, 32, 8)), jnp.bfloat16)
+        for _ in range(3)
+    )
+    out = _shard_ring(q, k, v, 4, devices8)
+    ref = dense_causal_attention(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=0.05
+    )
+
+
+def test_ring_dropout_semantics(devices8):
+    """Dropout drops attention *probabilities* (dense semantics): with
+    rate→0⁺ behavior intact, outputs stay finite, differ from the
+    deterministic pass, and keep the softmax-denominator normalization
+    (row means bounded by value range)."""
+    rng = np.random.default_rng(3)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((1, 2, 32, 8)), jnp.float32)
+        for _ in range(3)
+    )
+    mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+    spec = P(None, None, "seq", None)
+
+    def f(q, k, v):
+        return ring_causal_attention(
+            q, k, v, axis_name="seq", dropout_rate=0.5,
+            dropout_rng=jax.random.PRNGKey(0), deterministic=False,
+        )
+
+    out = jax.jit(
+        jax.shard_map(f, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)
+    )(q, k, v)
+    ref = _shard_ring(q, k, v, 4, jax.devices())
+    assert np.all(np.isfinite(np.asarray(out)))
+    assert not np.allclose(np.asarray(out), np.asarray(ref))
+    # denominator undropped → magnitudes stay in the value range ballpark
+    assert np.abs(np.asarray(out)).max() < np.abs(np.asarray(v)).max() * 4
+
+
+def test_flash_fallback_matches_dense():
+    """Off-TPU the flash path must fall back to dense exactly."""
+    rng = np.random.default_rng(2)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((1, 2, 16, 8)), jnp.float32)
+        for _ in range(3)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(flash_causal_attention(q, k, v)),
+        np.asarray(dense_causal_attention(q, k, v)),
+    )
+
+
+def _char_stream_ds(n=512, t=32, vocab=17, seed=0):
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(0, vocab, size=(n, t), dtype=np.int64)
+    tgt = np.roll(idx, -1, axis=1)
+    return ArrayDataset(idx, tgt)
+
+
+def _fit_gpt(cfg, cp, num_nodes=2, steps=6, seed=3):
+    ds = _char_stream_ds(seed=seed)
+    res = Trainer(GPT(cfg), ds, None).fit(
+        strategy=SimpleReduceStrategy(OptimSpec("adamw", lr=1e-3)),
+        num_nodes=num_nodes, max_steps=steps, batch_size=8,
+        minibatch_size=8, cp=cp, val_interval=0, show_progress=False,
+        seed=7, log_dir="/tmp/gym_tpu_test_logs",
+    )
+    return res
+
+
+def test_context_parallel_gpt_matches_dense(devices8):
+    """Same seed, same data: cp=2 ring GPT ≡ cp=1 dense GPT."""
+    base = dict(block_size=32, vocab_size=17, n_layer=2, n_head=2,
+                n_embd=32, dropout=0.0, bias=True)
+    with jax.default_matmul_precision("highest"):
+        res_dense = _fit_gpt(GPTConfig(**base), cp=1)
+        res_ring = _fit_gpt(
+            GPTConfig(**base, attn_impl="ring", seq_axis="seq"), cp=2
+        )
+    l_dense = [l for _, l in res_dense.history["train_loss"]]
+    l_ring = [l for _, l in res_ring.history["train_loss"]]
+    np.testing.assert_allclose(l_ring, l_dense, rtol=2e-4, atol=2e-4)
+    for a, b in zip(jax.tree.leaves(res_dense.params),
+                    jax.tree.leaves(res_ring.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-3)
+
+
+def test_context_parallel_with_diloco(devices8):
+    """CP composes with a communication strategy (seq axis orthogonal to the
+    node axes): 4 nodes × cp=2 on 8 devices, DiLoCo outer loop fires."""
+    cfg = GPTConfig(block_size=32, vocab_size=17, n_layer=2, n_head=2,
+                    n_embd=32, dropout=0.0, bias=True,
+                    attn_impl="ring", seq_axis="seq")
+    ds = _char_stream_ds()
+    res = Trainer(GPT(cfg), ds, _char_stream_ds(seed=9)).fit(
+        strategy=DiLoCoStrategy(optim_spec=OptimSpec("adamw", lr=1e-3), H=2),
+        num_nodes=4, max_steps=5, batch_size=8, minibatch_size=8, cp=2,
+        val_size=8, val_interval=2, show_progress=False,
+        log_dir="/tmp/gym_tpu_test_logs",
+    )
+    losses = [l for _, l in res.history["train_loss"]]
+    assert np.all(np.isfinite(losses))
+    comm = [c for _, c in res.history["comm_bytes"]]
+    assert any(c > 0 for c in comm)  # outer round communicated
+    for leaf in jax.tree.leaves(res.params):
+        assert np.all(np.isfinite(leaf))
