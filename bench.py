@@ -46,35 +46,52 @@ def main() -> None:
     from gym_tpu.parallel.mesh import NodeRuntime
     from gym_tpu.strategy.diloco import DiLoCoStrategy
     from gym_tpu.strategy.optim import OptimSpec
-    from gym_tpu.train_node import make_init_fn, make_train_step
+    from gym_tpu.train_node import make_init_fn, make_multi_train_step
 
+    import jax.numpy as jnp
+
+    attn = os.environ.get("GYM_TPU_BENCH_ATTN",
+                          "dense" if force_cpu else "flash")
     cfg = GPTConfig(block_size=BLOCK_SIZE, vocab_size=VOCAB, n_layer=4,
-                    n_head=4, n_embd=128, dropout=0.0, bias=True)
-    loss_model = LossModel(GPT(cfg))
+                    n_head=4, n_embd=128, dropout=0.0, bias=True,
+                    attn_impl=attn)
+    # bf16 forward (params stay f32; loss/softmax accumulate f32) — the
+    # TPU-native analog of the reference's autocast, default ON for the
+    # benchmark since MXU bf16 is the intended number format.
+    bf16 = os.environ.get("GYM_TPU_BENCH_BF16", "1") == "1"
+    loss_model = LossModel(GPT(cfg), jnp.bfloat16 if bf16 else None)
+
+    spc = int(os.environ.get("GYM_TPU_BENCH_SPC", 10))
+    warm_calls = max(1, WARMUP // spc)
+    timed_calls = max(1, TIMED // spc)
 
     strategy = DiLoCoStrategy(
         optim_spec=OptimSpec("adamw", lr=3e-4), H=100,
         lr_scheduler="lambda_cosine",
         lr_scheduler_kwargs={"warmup_steps": 100},
     )
-    strategy.finalize(max_steps=WARMUP + TIMED)
+    strategy.finalize(max_steps=(warm_calls + timed_calls) * spc)
 
     runtime = NodeRuntime.create(NUM_NODES, jax.devices())
 
+    # S steps per dispatch: amortizes host→device dispatch latency (large
+    # over remote transports) across a lax.scan of compiled steps.
     rng = np.random.default_rng(0)
     idx = rng.integers(
-        0, VOCAB, (NUM_NODES, 1, BATCH_PER_NODE, BLOCK_SIZE), dtype=np.int64
+        0, VOCAB, (NUM_NODES, spc, 1, BATCH_PER_NODE, BLOCK_SIZE),
+        dtype=np.int64,
     )
-    batch = runtime.shard_batch((idx, np.roll(idx, -1, axis=-1)))
+    batches = runtime.shard_batch((idx, np.roll(idx, -1, axis=-1)))
 
-    init_fn = make_init_fn(loss_model, strategy, (idx[0, 0], idx[0, 0]),
-                           seed=42)
+    init_fn = make_init_fn(loss_model, strategy,
+                           (idx[0, 0, 0], idx[0, 0, 0]), seed=42)
     state = runtime.init_state(init_fn)
-    train_step = runtime.compile(make_train_step(loss_model, strategy,
-                                                 runtime.ctx))
+    multi_step = runtime.compile(
+        make_multi_train_step(loss_model, strategy, runtime.ctx)
+    )
 
-    for _ in range(WARMUP):
-        state, metrics = train_step(state, batch)
+    for _ in range(warm_calls):
+        state, metrics = multi_step(state, batches)
     # NB: device_get, not block_until_ready — some transport backends
     # (e.g. the axon tunnel) resolve block_until_ready before execution
     # finishes; fetching a value that depends on the whole step chain is
@@ -82,12 +99,12 @@ def main() -> None:
     float(np.asarray(metrics["loss"]).sum())
 
     t0 = time.perf_counter()
-    for _ in range(TIMED):
-        state, metrics = train_step(state, batch)
+    for _ in range(timed_calls):
+        state, metrics = multi_step(state, batches)
     loss = float(np.asarray(metrics["loss"]).mean())
     dt = time.perf_counter() - t0
 
-    it_s = TIMED / dt
+    it_s = timed_calls * spc / dt
     assert np.isfinite(loss), f"non-finite loss {loss}"
 
     baseline = float(os.environ.get("GYM_TPU_BENCH_BASELINE",

@@ -54,8 +54,26 @@ def flash_causal_attention(
             q, k, v, dropout_rate=dropout_rate, dropout_rng=dropout_rng,
             deterministic=deterministic,
         )
+    from .fused_attention import fused_causal_attention, fused_supported
+    if fused_supported(q):
+        # whole-context fused kernel: fastest at the reference's shapes
+        # (T ≤ 1024), probs never touch HBM in fwd or bwd
+        return fused_causal_attention(q, k, v)
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         flash_attention,
     )
     scale = 1.0 / float(q.shape[-1]) ** 0.5
     return flash_attention(q, k, v, causal=True, sm_scale=scale)
+
+
+def packed_flash_attention_or_none(q, k, v, n_head: int):
+    """Packed-layout fast path: q/k/v [B, T, C] → output [B, T, C] with NO
+    head transposes, via the fused Pallas kernel. Returns None when the
+    kernel is not eligible (off-TPU, untileable T, dropout handled by the
+    caller) so the caller can take the standard [B, H, T, D] path. This is
+    THE dispatch point for packed eligibility — models must not
+    re-implement the platform/shape checks."""
+    from .fused_attention import fused_causal_attention_packed, fused_supported
+    if not _on_tpu() or not fused_supported(q):
+        return None
+    return fused_causal_attention_packed(q, k, v, n_head)

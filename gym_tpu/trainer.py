@@ -22,7 +22,8 @@ from .data.sampler import NodeBatchIterator, resolve_node_datasets
 from .models.base import LossModel, as_loss_model
 from .parallel.mesh import NodeRuntime
 from .strategy.base import Strategy, tree_num_params
-from .train_node import make_eval_step, make_init_fn, make_train_step
+from .train_node import (make_eval_step, make_init_fn, make_multi_train_step,
+                         make_train_step)
 from .utils.checkpoint import CheckpointManager
 from .utils.logger import CSVLogger, Logger, WandbLogger
 
@@ -81,6 +82,8 @@ class Trainer:
         val_interval: int = 100,
         autocast: bool = False,
         cp: int = 1,
+        steps_per_call: int = 1,
+        profile_dir: Optional[str] = None,
         checkpoint_interval: Optional[int] = None,
         save_dir: Optional[str] = None,
         seed: int = 42,
@@ -167,6 +170,11 @@ class Trainer:
         train_step = runtime.compile(
             make_train_step(loss_model, strategy, runtime.ctx)
         )
+        multi_step = None
+        if steps_per_call > 1:
+            multi_step = runtime.compile(
+                make_multi_train_step(loss_model, strategy, runtime.ctx)
+            )
         eval_step = runtime.compile(
             make_eval_step(loss_model, runtime.ctx), donate_state=False
         )
@@ -225,35 +233,83 @@ class Trainer:
             logger.pbar.update(start_step)
 
         def drain(p):
+            """Fetch and log a finished dispatch: 1 step ([K] metrics) or a
+            multi-step call ([K, S] metrics, node 0's row logged per step)."""
             nonlocal last_loss
-            step_idx, m = p
-            loss = float(np.asarray(m["loss"])[0])
-            comm = float(np.asarray(m["comm_bytes"])[0])
-            last_loss = loss
-            lr = strategy.lr_at(step_idx)
-            logger.log_train(loss, lr, comm)
-            history["train_loss"].append((step_idx, loss))
-            history["comm_bytes"].append((step_idx, comm))
+            first_idx, m, count = p
+            loss_a = np.asarray(m["loss"])[0].reshape(count)
+            comm_a = np.asarray(m["comm_bytes"])[0].reshape(count)
+            for j in range(count):
+                step_j = first_idx + j
+                loss = float(loss_a[j])
+                comm = float(comm_a[j])
+                last_loss = loss
+                logger.log_train(loss, strategy.lr_at(step_j), comm)
+                history["train_loss"].append((step_j, loss))
+                history["comm_bytes"].append((step_j, comm))
 
-        for step_idx in range(start_step, max_steps):
-            if val_interval and step_idx % val_interval == 0:
+        # Profiling (SURVEY §5.1 — absent in the reference): capture an
+        # XLA/TPU trace of a few post-warmup steps, viewable in
+        # TensorBoard / Perfetto.
+        profiling = False
+        # window must contain a dispatch boundary: boundaries advance by
+        # steps_per_call, so span at least one full call past warmup
+        profile_start = start_step + 2
+        profile_stop = min(max_steps,
+                           profile_start + max(8, 2 * steps_per_call))
+
+        step_idx = start_step
+        while step_idx < max_steps:
+            if profile_dir and not profiling and step_idx >= profile_start \
+                    and step_idx < profile_stop:
+                jax.profiler.start_trace(profile_dir)
+                profiling = True
+            if profiling and step_idx >= profile_stop:
+                jax.profiler.stop_trace()
+                profiling = False
+            s = min(steps_per_call, max_steps - step_idx)
+            if s < steps_per_call or multi_step is None:
+                s = 1  # remainder runs on the single-step program
+            # eval at dispatch boundaries (with steps_per_call > 1 the
+            # boundary is quantized to the call that contains it)
+            eval_due = bool(val_interval) and (
+                step_idx % val_interval == 0
+                or (s > 1 and (step_idx % val_interval) + s > val_interval)
+            )
+            if eval_due:
                 if pending is not None:
                     drain(pending)
                     pending = None
                 run_eval()
-            batch = runtime.shard_batch(
-                train_iter.next_batch(n_micro, minibatch_size)
-            )
-            state, metrics = train_step(state, batch)
+            if s > 1:
+                stacked = [train_iter.next_batch(n_micro, minibatch_size)
+                           for _ in range(s)]
+                batches = jax.tree.map(
+                    lambda *xs: np.stack(xs, axis=1), *stacked
+                )
+                state, metrics = multi_step(
+                    state, runtime.shard_batch(batches)
+                )
+            else:
+                batch = runtime.shard_batch(
+                    train_iter.next_batch(n_micro, minibatch_size)
+                )
+                state, metrics = train_step(state, batch)
             if pending is not None:
                 drain(pending)
-            pending = (step_idx, metrics)
-            logger.increment_step()
-            if ckpt is not None and (step_idx + 1) % checkpoint_interval == 0:
-                ckpt.save(step_idx + 1, state, train_iter.state())
+            pending = (step_idx, metrics, s)
+            for _ in range(s):
+                logger.increment_step()
+            prev_idx, step_idx = step_idx, step_idx + s
+            if ckpt is not None and (
+                step_idx // checkpoint_interval > prev_idx // checkpoint_interval
+            ):
+                ckpt.save(step_idx, state, train_iter.state())
 
         if pending is not None:
             drain(pending)
+        if profiling:
+            jax.profiler.stop_trace()
         jax.block_until_ready(state.params)
         elapsed = time.time() - t_start
         run_eval()

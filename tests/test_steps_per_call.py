@@ -1,0 +1,32 @@
+"""steps_per_call: S steps per dispatch must be semantically identical to
+S single dispatches — same batch order, same strategy schedule, same
+parameters; only the host↔device cadence changes."""
+
+import jax
+import numpy as np
+
+from gym_tpu import Trainer
+from gym_tpu.strategy import DiLoCoStrategy, OptimSpec
+from test_trainer_e2e import TinyLossModel, blobs
+
+
+def _fit(spc, steps=7):
+    ds = blobs(256, seed=8)
+    return Trainer(TinyLossModel(), ds, None).fit(
+        strategy=DiLoCoStrategy(optim_spec=OptimSpec("adamw", lr=1e-3), H=3),
+        num_nodes=4, max_steps=steps, batch_size=16, minibatch_size=8,
+        val_interval=0, show_progress=False, seed=13,
+        steps_per_call=spc, log_dir="/tmp/gym_tpu_test_logs",
+    )
+
+
+def test_multi_call_matches_single():
+    r1 = _fit(1)
+    r3 = _fit(3)  # 2 multi calls + 1 remainder step on the 1-step program
+    l1 = [l for _, l in r1.history["train_loss"]]
+    l3 = [l for _, l in r3.history["train_loss"]]
+    assert [s for s, _ in r3.history["train_loss"]] == list(range(7))
+    np.testing.assert_allclose(l3, l1, rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(r1.params), jax.tree.leaves(r3.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-5)
