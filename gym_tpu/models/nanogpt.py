@@ -144,10 +144,10 @@ class Block(nn.Module):
     def __call__(self, x, train: bool):
         cfg = self.config
         x = x + CausalSelfAttention(cfg, name="attn")(
-            nn.LayerNorm(use_bias=cfg.bias, name="ln_1")(x), train
+            nn.LayerNorm(epsilon=1e-5, use_bias=cfg.bias, name="ln_1")(x), train
         )
         x = x + MLP(cfg, name="mlp")(
-            nn.LayerNorm(use_bias=cfg.bias, name="ln_2")(x), train
+            nn.LayerNorm(epsilon=1e-5, use_bias=cfg.bias, name="ln_2")(x), train
         )
         return x
 
@@ -203,7 +203,7 @@ class GPT(nn.Module):
         x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
         for i in range(cfg.n_layer):
             x = Block(cfg, name=f"h_{i}")(x, train)
-        x = nn.LayerNorm(use_bias=cfg.bias, name="ln_f")(x)
+        x = nn.LayerNorm(epsilon=1e-5, use_bias=cfg.bias, name="ln_f")(x)
         # weight tying: lm_head = wteᵀ (reference :206-208)
         logits = wte.attend(x.astype(wte.embedding.dtype))
         if targets is None:

@@ -1,5 +1,9 @@
-from .axis import AxisCtx, NODE_AXIS, VNODE_AXIS, single_node_ctx
+from .axis import (AxisCtx, NODE_AXIS, SEQ_AXIS, VNODE_AXIS,
+                   single_node_ctx)
 from .mesh import NodeRuntime
+from .multihost import initialize as initialize_multihost, is_primary
+from .ring_attention import ring_causal_attention
 
-__all__ = ["AxisCtx", "NodeRuntime", "NODE_AXIS", "VNODE_AXIS",
-           "single_node_ctx"]
+__all__ = ["AxisCtx", "NodeRuntime", "NODE_AXIS", "VNODE_AXIS", "SEQ_AXIS",
+           "single_node_ctx", "ring_causal_attention",
+           "initialize_multihost", "is_primary"]

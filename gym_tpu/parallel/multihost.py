@@ -1,0 +1,77 @@
+"""Multi-host initialization and mesh construction.
+
+The reference scales by spawning more processes on ONE machine and wiring
+them with a TCP process group (`exogym/trainer.py:316-347`). On TPU pods the
+equivalent is: one process per host, `jax.distributed.initialize` for the
+control plane, and a `Mesh` over `jax.devices()` (which, after initialize,
+spans every chip in the slice — ICI within a slice, DCN across slices). No
+rendezvous code, no port juggling: XLA's collectives ride the fabric that
+the platform already wired.
+
+Usage on each host of a pod slice (env-driven — TPU VMs set everything):
+
+    import gym_tpu.parallel.multihost as mh
+    mh.initialize()                  # no-op on single host
+    trainer.fit(..., num_nodes=256)  # mesh spans the whole slice
+
+`NodeRuntime.create` already accepts the global device list; K simulated
+nodes fold onto (hosts × chips) exactly as they fold onto chips.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the multi-host collective world. Returns True if distributed
+    mode was initialized, False for the single-host fast path.
+
+    With no arguments, relies on the TPU platform's environment (GKE / TPU
+    VM metadata) the way ``jax.distributed.initialize()`` documents; args
+    mirror its manual override surface for DCN clusters.
+    """
+    already = getattr(initialize, "_done", False)
+    if already:
+        return True
+    # The gate must decide from the environment ONLY: touching the backend
+    # (jax.devices()/process_count()) before jax.distributed.initialize
+    # would initialize single-host and poison the pod path.
+    explicit = any(a is not None for a in
+                   (coordinator_address, num_processes, process_id))
+    env_hosts = int(os.environ.get("GYM_TPU_NUM_PROCESSES", "0") or 0)
+    workers = os.environ.get("TPU_WORKER_HOSTNAMES", "")  # pod VM metadata
+    cluster_env = (
+        bool(os.environ.get("JAX_COORDINATOR_ADDRESS"))        # manual
+        or bool(os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"))  # multislice
+        or len([h for h in workers.split(",") if h]) > 1
+    )
+    if not explicit and env_hosts <= 1 and not cluster_env:
+        # single-process: nothing to join
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    initialize._done = True
+    return True
+
+
+def is_primary() -> bool:
+    """True on the host that should own logging/checkpoint writes
+    (the analog of the reference's rank-0-only logger gate,
+    ``train_node.py:585-602``, at host granularity)."""
+    return jax.process_index() == 0
+
+
+def global_devices():
+    """All devices in the initialized world, in stable order."""
+    return jax.devices()
