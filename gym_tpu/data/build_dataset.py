@@ -33,6 +33,11 @@ CHAR_VOCAB = (
 GPT2_VOCAB_SIZE = 50257
 
 
+class WrongSchemaError(RuntimeError):
+    """A fetched dataset is missing its expected text column — must not be
+    masked by the offline-fallback handler."""
+
+
 def generate_char_vocab():
     char_int = {c: i for i, c in enumerate(CHAR_VOCAB)}
     eos_id = len(char_int)
@@ -83,18 +88,29 @@ def _try_hf_small(dataset: str, start_pc: float, end_pc: float):
     try:
         from datasets import concatenate_datasets, load_dataset
 
+        # text column is dataset-specific: picking "the first column" would
+        # silently train on repo names for codeparrot (ADVICE r1, medium)
         if dataset == "shakespeare":
             raw = load_dataset("Trelis/tiny-shakespeare")
+            text_cols = ("Text", "text")
         elif dataset == "code":
             raw = load_dataset("codeparrot/codeparrot-clean-valid")
+            text_cols = ("content",)
         else:
             raw = load_dataset("wikitext", "wikitext-103-v1")
+            text_cols = ("text",)
         parts = [raw[s] for s in raw.keys()]
         ds = concatenate_datasets(parts)
         n = len(ds)
         lo, hi = int(n * start_pc), int(n * end_pc)
         ds = ds.select(range(lo, hi))
-        texts = [r[list(r.keys())[0]] for r in ds]
+        col = next((c for c in text_cols if c in ds.column_names), None)
+        if col is None:
+            raise WrongSchemaError(
+                f"none of the expected text columns {text_cols} present in "
+                f"{dataset!r} (has {ds.column_names})"
+            )
+        texts = ds[col]  # whole-column Arrow read, not per-row dicts
         if dataset == "shakespeare":
             char_int, eos = generate_char_vocab()
             stream = []
@@ -109,6 +125,10 @@ def _try_hf_small(dataset: str, start_pc: float, end_pc: float):
             stream.extend(tok.encode(t))
             stream.append(tok.eos_token_id)
         return np.asarray(stream, np.uint16)
+    except WrongSchemaError:
+        # the dataset WAS fetched but has an unexpected schema — falling
+        # back to synthetic here would silently train on the wrong corpus
+        raise
     except Exception as e:  # offline / missing dep — fall back
         _log(f"HF fetch for {dataset!r} unavailable ({type(e).__name__}); "
              f"using deterministic synthetic corpus")

@@ -95,13 +95,23 @@ def gather_windows(
     """Fused (x, y) next-token window gather: ``x[r] = src[i:i+W]``,
     ``y[r] = src[i+1:i+W+1]`` as int32. Native when possible, numpy
     otherwise — identical results either way."""
+    idx = np.ascontiguousarray(idx, np.int64)
+    # an out-of-range index would silently read out-of-bounds host memory
+    # in the C++ kernel and silently wrap in numpy fancy indexing — both
+    # paths must raise identically (ADVICE r1)
+    if len(idx) and (int(idx.min()) < 0
+                     or int(idx.max()) + window + 1 > len(src)):
+        raise IndexError(
+            f"gather_windows: index range [{int(idx.min())}, "
+            f"{int(idx.max())}] + window {window} exceeds source of "
+            f"length {len(src)}"
+        )
     lib = _get_lib()
     key = np.dtype(src.dtype)
     if lib is None or key not in _FN_BY_DTYPE or not src.flags.c_contiguous:
-        win = src[np.asarray(idx)[:, None] + np.arange(window + 1)]
+        win = src[idx[:, None] + np.arange(window + 1)]
         return win[:, :-1].astype(np.int32), win[:, 1:].astype(np.int32)
     name, src_t = _FN_BY_DTYPE[key]
-    idx = np.ascontiguousarray(idx, np.int64)
     count = len(idx)
     x = np.empty((count, window), np.int32)
     y = np.empty((count, window), np.int32)

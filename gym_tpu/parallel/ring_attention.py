@@ -110,12 +110,19 @@ def ring_causal_attention(
         return (o_acc, m_new, l_acc, kc, vc), None
 
     b_, h_, _, d_ = q.shape
-    # pvary: mark the fresh accumulators as device-varying over the ring
-    # axis so the scan carry type matches its output (shard_map VMA rule).
-    o0 = lax.pvary(jnp.zeros((b_, h_, tl, d_), jnp.float32), (axis_name,))
-    m0 = lax.pvary(jnp.full((b_, h_, tl, 1), -1e30, jnp.float32),
-                   (axis_name,))
-    l0 = lax.pvary(jnp.zeros((b_, h_, tl, 1), jnp.float32), (axis_name,))
+
+    # mark the fresh accumulators as device-varying over the ring axis so
+    # the scan carry type matches its output (shard_map VMA rule);
+    # lax.pvary is deprecated in favor of pcast(..., to='varying')
+    if hasattr(lax, "pcast"):
+        def _vary(x):
+            return lax.pcast(x, (axis_name,), to="varying")
+    else:  # pragma: no cover — older JAX
+        def _vary(x):
+            return lax.pvary(x, (axis_name,))
+    o0 = _vary(jnp.zeros((b_, h_, tl, d_), jnp.float32))
+    m0 = _vary(jnp.full((b_, h_, tl, 1), -1e30, jnp.float32))
+    l0 = _vary(jnp.zeros((b_, h_, tl, 1), jnp.float32))
 
     (o, m, l, _, _), _ = lax.scan(
         ring_step, (o0, m0, l0, k, v), jnp.arange(n)

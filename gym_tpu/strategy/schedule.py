@@ -9,6 +9,7 @@ then either constant 1.0 or cosine anneal to a 0.1 floor over
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 
 def warmup_cosine_scale(
@@ -16,6 +17,7 @@ def warmup_cosine_scale(
     warmup_steps: int = 1,
     cosine_anneal: bool = False,
     min_lr_factor: float = 0.1,
+    xp=jnp,
 ):
     """Return ``scale(step) -> multiplier in (0, 1]``.
 
@@ -23,33 +25,37 @@ def warmup_cosine_scale(
     ``step / max(warmup_steps, 1)``; cosine term decays to
     ``min_lr_factor``; without ``cosine_anneal`` the post-warmup factor
     is 1.0 (``strategy.py:75-85``).
+
+    ``xp`` selects the array module: ``jnp`` for use inside jitted optax
+    transforms, ``numpy`` for host-side logging (zero device ops per call).
     """
     warmup_steps = int(warmup_steps)
     max_steps = int(max_steps)
 
     def scale(step):
-        step = jnp.asarray(step, jnp.float32)
-        warm = step / jnp.maximum(warmup_steps, 1)
+        step = xp.asarray(step, xp.float32)
+        warm = step / xp.maximum(warmup_steps, 1)
         if cosine_anneal:
             progress = (step - warmup_steps) / max(
                 1, max_steps - warmup_steps
             )
-            progress = jnp.clip(progress, 0.0, 1.0)
-            cosine = 0.5 * (1.0 + jnp.cos(jnp.pi * progress))
+            progress = xp.clip(progress, 0.0, 1.0)
+            cosine = 0.5 * (1.0 + xp.cos(xp.pi * progress))
             post = (1 - min_lr_factor) * cosine + min_lr_factor
         else:
-            post = jnp.asarray(1.0, jnp.float32)
-        return jnp.where(step < warmup_steps, warm, post)
+            post = xp.asarray(1.0, xp.float32)
+        return xp.where(step < warmup_steps, warm, post)
 
     return scale
 
 
-def build_lr_scale(lr_scheduler, lr_scheduler_kwargs, max_steps: int):
+def build_lr_scale(lr_scheduler, lr_scheduler_kwargs, max_steps: int, xp=jnp):
     """Resolve the strategy's scheduler config into a scale fn (or None).
 
     ``lr_scheduler='lambda_cosine'`` is the only named scheduler in the
     reference (``strategy.py:87-88``); kwargs: ``warmup_steps``,
     ``cosine_anneal``, optional ``max_steps`` cap (``strategy.py:67-73``).
+    Pass ``xp=numpy`` for a host-only evaluator (logging path).
     """
     if lr_scheduler is None:
         return None
@@ -63,4 +69,5 @@ def build_lr_scale(lr_scheduler, lr_scheduler_kwargs, max_steps: int):
         max_steps=capped,
         warmup_steps=int(kw.get("warmup_steps", 1)),
         cosine_anneal=bool(kw.get("cosine_anneal", False)),
+        xp=xp,
     )

@@ -44,6 +44,28 @@ class FitResult:
     history: Dict[str, List]
 
 
+def _model_config(module) -> Dict[str, Any]:
+    """Recursive model-hyperparameter capture (reference ``create_config``
+    records model name, param count and full module config,
+    ``exogym/utils.py:102-143``): a flax module's dataclass fields, with a
+    nested ``config`` dataclass (the GPTConfig convention) flattened in."""
+    out: Dict[str, Any] = {}
+    for field in dataclasses.fields(module) if dataclasses.is_dataclass(
+            module) else ():
+        if field.name in ("parent", "name"):
+            continue
+        v = getattr(module, field.name, None)
+        if dataclasses.is_dataclass(v) and not isinstance(v, type):
+            out[field.name] = {
+                f.name: getattr(v, f.name) for f in dataclasses.fields(v)
+                if isinstance(getattr(v, f.name),
+                              (int, float, str, bool, type(None)))
+            }
+        elif isinstance(v, (int, float, str, bool, type(None))):
+            out[field.name] = v
+    return out
+
+
 def _resolve_devices(device: Optional[str], devices: Optional[List[int]]):
     if device is None:
         devs = jax.devices()
@@ -96,6 +118,16 @@ class Trainer:
         assert strategy is not None, "fit requires a strategy"
         if extra:
             raise TypeError(f"Unknown fit() kwargs: {sorted(extra)}")
+        if val_interval and steps_per_call > val_interval:
+            # at most one eval fires per dispatch, so eval frequency would
+            # silently drop to once per call (ADVICE r1)
+            import warnings
+            warnings.warn(
+                f"steps_per_call={steps_per_call} > val_interval="
+                f"{val_interval}: evals fire at dispatch boundaries, so "
+                f"effective eval cadence is once per {steps_per_call} steps",
+                stacklevel=2,
+            )
         minibatch_size = minibatch_size or batch_size
         assert batch_size % minibatch_size == 0, \
             "batch_size must be a multiple of minibatch_size"
@@ -179,13 +211,18 @@ class Trainer:
             make_eval_step(loss_model, runtime.ctx), donate_state=False
         )
 
+        # Per-node parameter count: state.params has a leading [K] node axis.
+        per_node_params = int(sum(
+            int(np.prod(l.shape[1:])) for l in jax.tree.leaves(state.params)
+        ))
         config = {
             "num_nodes": num_nodes, "batch_size": batch_size,
             "minibatch_size": minibatch_size, "max_steps": max_steps,
             "num_epochs": num_epochs, "seed": seed,
             "autocast": autocast,
             "model": type(loss_model.module).__name__,
-            "num_params": None,  # filled below
+            "num_params": per_node_params,
+            "model_config": _model_config(loss_model.module),
             "mesh": {"physical": runtime.n_phys, "virtual": runtime.n_virt,
                      "cp": runtime.cp},
             **strategy.config(),

@@ -23,6 +23,18 @@ def test_native_builds_here():
     assert native_available(np.uint16)
 
 
+def test_out_of_range_raises_like_numpy():
+    """The C++ kernel must not silently read out-of-bounds host memory —
+    both paths raise IndexError on bad indices (ADVICE r1)."""
+    src = np.arange(100, dtype=np.uint16)
+    with pytest.raises(IndexError):
+        gather_windows(src, np.array([95]), 8)  # 95+9 > 100
+    with pytest.raises(IndexError):
+        gather_windows(src, np.array([-1]), 8)
+    x, y = gather_windows(src, np.array([91]), 8)  # 91+9 = 100: max legal
+    np.testing.assert_array_equal(x[0], np.arange(91, 99))
+
+
 def test_contiguous_dataset_uses_gather():
     from gym_tpu.data import ContiguousGPTTrainDataset
 
