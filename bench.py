@@ -42,7 +42,7 @@ def main() -> None:
     import numpy as np
 
     from gym_tpu.models.base import LossModel
-    from gym_tpu.models.nanogpt import GPT, GPTConfig
+    from gym_tpu.models.nanogpt import GPT, GPTConfig, node_mfu
     from gym_tpu.parallel.mesh import NodeRuntime
     from gym_tpu.strategy.diloco import DiLoCoStrategy
     from gym_tpu.strategy.optim import OptimSpec
@@ -109,11 +109,14 @@ def main() -> None:
 
     baseline = float(os.environ.get("GYM_TPU_BENCH_BASELINE",
                                     CPU_BASELINE_IT_S))
+    # MFU of the whole 64-node workload (seqs/iter = nodes × per-node batch)
+    mfu = node_mfu(cfg, state.params, NUM_NODES * BATCH_PER_NODE, 1.0 / it_s)
     print(json.dumps({
         "metric": "nanogpt_diloco_64node_iterations_per_sec",
         "value": round(it_s, 3),
         "unit": "it/s",
         "vs_baseline": round(it_s / baseline, 2),
+        "mfu": round(mfu, 4),
     }))
 
 

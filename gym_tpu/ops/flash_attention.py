@@ -73,7 +73,7 @@ def packed_flash_attention_or_none(q, k, v, n_head: int):
     caller) so the caller can take the standard [B, H, T, D] path. This is
     THE dispatch point for packed eligibility — models must not
     re-implement the platform/shape checks."""
-    from .fused_attention import fused_causal_attention_packed, fused_supported
-    if not _on_tpu() or not fused_supported(q):
+    from .fused_attention import fused_causal_attention_packed, packed_supported
+    if not _on_tpu() or not packed_supported(q, n_head):
         return None
     return fused_causal_attention_packed(q, k, v, n_head)

@@ -166,6 +166,21 @@ def fused_supported(q) -> bool:
     return t <= 1024 and t % 128 == 0
 
 
+def packed_supported(q, n_head: int) -> bool:
+    """Eligibility for the packed [B, T, C] kernels: unlike the per-head
+    [B, H, T, D] layout, a packed program keeps ALL heads' rows in VMEM at
+    once, so at GPT-2-base shapes (T=1024, C=768) it exceeds the 16 MB
+    scoped-VMEM limit. Estimate the backward pass's live set at the chosen
+    batch chunk and reject anything near the limit."""
+    b, t, c = q.shape[0], q.shape[-2], q.shape[-1]
+    if not (fused_supported(q) and c % n_head == 0):
+        return False
+    bc = _packed_chunk(b, t)
+    # bwd live set: 8 packed tensors as f32 working copies + s/p/dp blocks
+    vmem = 8 * bc * t * c * 4 + 3 * bc * t * t * 4
+    return vmem <= 10 * 1024 * 1024
+
+
 # -- packed layout: [B, T, C] with C = H·D -------------------------------
 #
 # The standard [B, H, T, D] layout costs two transposes per attention call

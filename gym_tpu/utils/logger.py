@@ -58,6 +58,13 @@ class Logger:
         if self.pbar is not None:
             self.pbar.update(1)
 
+    def log_summary(self, summary: Dict[str, Any]) -> None:
+        """End-of-run aggregates (it/s, MFU, comm totals)."""
+        if self.pbar is not None:
+            mfu = summary.get("mfu")
+            if mfu is not None:
+                self.pbar.write(f"MFU {mfu:.1%}")
+
     def close(self) -> None:
         if self.pbar is not None:
             self.pbar.close()
@@ -115,6 +122,11 @@ class CSVLogger(Logger):
         )
         self._val_f.flush()
 
+    def log_summary(self, summary):
+        super().log_summary(summary)
+        with open(os.path.join(self.run_dir, "summary.json"), "w") as f:
+            json.dump(_jsonable(summary), f, indent=2, default=str)
+
     def close(self):
         super().close()
         self._train_f.close()
@@ -156,6 +168,13 @@ class WandbLogger(Logger):
                 {f"{name}/loss": loss,
                  f"{name}/perplexity": math.exp(min(loss, 20.0))},
                 step=self.step,
+            )
+
+    def log_summary(self, summary):
+        super().log_summary(summary)
+        if self._run is not None:
+            self._run.summary.update(
+                {k: v for k, v in summary.items() if v is not None}
             )
 
     def close(self):
