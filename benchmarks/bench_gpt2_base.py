@@ -39,7 +39,7 @@ def main() -> None:
     ap.add_argument("--remat", action="store_true")
     ap.add_argument("--no-bf16", action="store_true")
     ap.add_argument("--strategy", default="diloco",
-                    choices=["diloco", "simple"])
+                    choices=["diloco", "simple", "demo"])
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--spc", type=int, default=5,
@@ -80,6 +80,9 @@ def main() -> None:
     if args.strategy == "diloco":
         strategy = DiLoCoStrategy(optim_spec=OptimSpec("adamw", lr=3e-4),
                                   H=100)
+    elif args.strategy == "demo":
+        from gym_tpu.strategy.demo import DeMoStrategy
+        strategy = DeMoStrategy(optim_spec=OptimSpec("sgd", lr=1e-3))
     else:
         strategy = SimpleReduceStrategy(OptimSpec("adamw", lr=3e-4))
 

@@ -50,17 +50,3 @@ def scatter_mean_decode(idx: jnp.ndarray, val: jnp.ndarray,
     return out.reshape(n_chunks, chunk_elems)
 
 
-def gather_concat(ctx, idx: jnp.ndarray, val: jnp.ndarray):
-    """All-gather each node's (idx, val) and concatenate along the k axis —
-    the reference's paired async all_gathers + concat
-    (``demo.py:119-140``, ``demo.py:349-352``)."""
-    g_idx = ctx.all_gather(idx)   # [K, n_chunks, k]
-    g_val = ctx.all_gather(val)
-    k_nodes = g_idx.shape[0]
-    cat_idx = jnp.moveaxis(g_idx, 0, -2).reshape(
-        idx.shape[0], k_nodes * idx.shape[1]
-    )
-    cat_val = jnp.moveaxis(g_val, 0, -2).reshape(
-        val.shape[0], k_nodes * val.shape[1]
-    )
-    return cat_idx, cat_val

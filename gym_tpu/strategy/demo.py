@@ -29,8 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.dct import codec_for
-from ..ops.topk_compress import (gather_concat, scatter_mean_decode,
-                                 topk_compress)
+from ..ops.topk_compress import scatter_mean_decode, topk_compress
 from .base import PyTree, Strategy
 from .optim import OptimSpec, ensure_optim_spec
 
@@ -82,51 +81,84 @@ class DeMoStrategy(Strategy):
         beta = self.compression_decay
         topk = self.compression_topk
 
-        comm_total = jnp.zeros(())
-        new_params_leaves = []
-        new_delta_leaves = []
-
         p_leaves, treedef = jax.tree.flatten(params)
         g_leaves = jax.tree.leaves(grads)
         d_leaves = jax.tree.leaves(state["delta"])
+        codecs = [codec_for(tuple(p.shape), self.compression_chunk)
+                  for p in p_leaves]
 
-        for p, g, delta in zip(p_leaves, g_leaves, d_leaves):
-            codec = codec_for(tuple(p.shape), self.compression_chunk)
-            # 1-2. decay + accumulate (reference demo.py:162-167)
+        # Phase 1 (local, per leaf): momentum update, chunked DCT, top-k,
+        # residual correction (reference demo.py:162-180).
+        picks = []                         # (idx, val) per leaf
+        new_delta_leaves = []
+        for p, g, delta, codec in zip(p_leaves, g_leaves, d_leaves, codecs):
             delta = (beta * delta.reshape(codec.shape)
                      + lr * g.reshape(codec.shape))
-            # 3. chunked DCT + top-k
             coeffs = codec.encode(delta)
             idx, val = topk_compress(coeffs, topk)
-            # 4. remove transmitted estimate from residual (demo.py:170-180)
             est = codec.decode(scatter_mean_decode(idx, val,
                                                    codec.chunk_elems))
-            delta = delta - est
-            # 5-6. gather all nodes' picks, decode with mean (demo.py:183-197)
-            cat_idx, cat_val = gather_concat(ctx, idx, val)
-            decoded = codec.decode(
-                scatter_mean_decode(cat_idx, cat_val, codec.chunk_elems)
+            new_delta_leaves.append((delta - est).reshape(p.shape))
+            picks.append((idx, val))
+
+        # Phase 2 (communication): the reference all-gathers per parameter —
+        # ~2 collectives × n_leaves per step (demo.py:119-140), a long
+        # serial trace at GPT-base's ~150 leaves. Here all leaves with the
+        # same (chunk_elems, k) signature are concatenated along the chunk
+        # axis and (val, idx-bitcast) are packed into ONE f32 payload, so a
+        # GPT emits O(#distinct chunk shapes) ≈ 2 all_gathers per step
+        # regardless of depth (VERDICT r1 #3).
+        groups = {}
+        for i, codec in enumerate(codecs):
+            key = (codec.chunk_elems, picks[i][0].shape[-1])
+            groups.setdefault(key, []).append(i)
+
+        decoded = [None] * len(p_leaves)
+        comm_tx = 0.0
+        for (chunk_elems, k), leaf_ids in sorted(groups.items()):
+            cat_idx = jnp.concatenate([picks[i][0] for i in leaf_ids], axis=0)
+            cat_val = jnp.concatenate([picks[i][1] for i in leaf_ids], axis=0)
+            payload = jnp.concatenate(
+                [cat_val.astype(jnp.float32),
+                 jax.lax.bitcast_convert_type(cat_idx, jnp.float32)], axis=-1
             )
-            # 7. sign-SGD with optional step-weight-decay (demo.py:159-160,
-            # 206-209)
+            gathered = ctx.all_gather(payload)     # [K, G_chunks, 2k]
+            k_nodes = gathered.shape[0]
+            g_val = gathered[..., :k]
+            g_idx = jax.lax.bitcast_convert_type(gathered[..., k:], jnp.int32)
+            # [K, G, k] → [G, K·k]: concat every node's picks per chunk
+            all_val = jnp.moveaxis(g_val, 0, -2).reshape(
+                cat_val.shape[0], k_nodes * k)
+            all_idx = jnp.moveaxis(g_idx, 0, -2).reshape(
+                cat_idx.shape[0], k_nodes * k)
+            dense = scatter_mean_decode(all_idx, all_val, chunk_elems)
+            off = 0
+            for i in leaf_ids:
+                n = codecs[i].n_chunks
+                decoded[i] = codecs[i].decode(dense[off:off + n])
+                off += n
+            comm_tx += float(cat_idx.shape[0] * k * 8)  # int32 idx + f32 val
+
+        # Phase 3 (local): sign-SGD with optional step-weight-decay
+        # (reference demo.py:159-160, 206-209).
+        new_params_leaves = []
+        for p, codec, dec in zip(p_leaves, codecs, decoded):
             new_p = p.reshape(codec.shape)
             if self.weight_decay:
                 new_p = new_p * (1.0 - lr * self.weight_decay)
-            new_p = new_p - lr * jnp.sign(decoded)
+            new_p = new_p - lr * jnp.sign(dec)
             new_params_leaves.append(new_p.reshape(p.shape).astype(p.dtype))
-            new_delta_leaves.append(delta.reshape(p.shape))
-            # transmit payload: (int32 idx + f32 val) per pick per chunk
-            comm_total = comm_total + jnp.asarray(
-                float(codec.n_chunks * min(topk, codec.chunk_elems) * 8),
-                jnp.float32,
-            )
 
         new_params = jax.tree.unflatten(treedef, new_params_leaves)
         new_delta = jax.tree.unflatten(treedef, new_delta_leaves)
+        # both directions, matching the reference's data_transmit AND
+        # data_receive counters (demo_impl/demo.py:145-146, 187-190)
         return (
             new_params,
             {"delta": new_delta},
-            {"comm_bytes": comm_total},
+            {"comm_bytes": jnp.asarray(comm_tx, jnp.float32),
+             "comm_recv_bytes": jnp.asarray(
+                 comm_tx * (ctx.num_nodes - 1), jnp.float32)},
         )
 
     def config(self):

@@ -239,7 +239,7 @@ class Trainer:
 
         history: Dict[str, List] = {
             "train_loss": [], "local_loss": [], "global_loss": [],
-            "comm_bytes": [],
+            "comm_bytes": [], "comm_recv_bytes": [],
         }
 
         def run_eval():
@@ -276,6 +276,8 @@ class Trainer:
             first_idx, m, count = p
             loss_a = np.asarray(m["loss"])[0].reshape(count)
             comm_a = np.asarray(m["comm_bytes"])[0].reshape(count)
+            recv_a = (np.asarray(m["comm_recv_bytes"])[0].reshape(count)
+                      if "comm_recv_bytes" in m else None)
             for j in range(count):
                 step_j = first_idx + j
                 loss = float(loss_a[j])
@@ -284,6 +286,10 @@ class Trainer:
                 logger.log_train(loss, strategy.lr_at(step_j), comm)
                 history["train_loss"].append((step_j, loss))
                 history["comm_bytes"].append((step_j, comm))
+                if recv_a is not None:
+                    history["comm_recv_bytes"].append(
+                        (step_j, float(recv_a[j]))
+                    )
 
         # Profiling (SURVEY §5.1 — absent in the reference): capture an
         # XLA/TPU trace of a few post-warmup steps, viewable in

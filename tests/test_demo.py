@@ -114,6 +114,85 @@ def test_demo_residual_accumulates_untransmitted():
     assert float(m["comm_bytes"][0]) == 8  # 1 chunk × 1 pick × 8 bytes
 
 
+def _count_all_gathers(strat, num_nodes, params_np):
+    rt = NodeRuntime.create(num_nodes)
+    strat.finalize(10)
+    init = rt.compile(lambda p: strat.init(p), donate_state=False)
+    params = rt.shard_batch(params_np)
+    state = init(params)
+    grads = rt.shard_batch(jax.tree.map(np.ones_like, params_np))
+    tvec = rt.shard_batch(np.zeros(num_nodes, np.int32))
+    fn = rt.compile(lambda p, s, g, t: strat.step(g, p, s, t, rt.ctx),
+                    donate_state=False)
+    hlo = fn.lower(params, state, grads, tvec).compile().as_text()
+    return hlo.count("all-gather")
+
+
+def test_demo_collective_count_independent_of_depth():
+    """The grouped+packed communication phase must emit O(#chunk-shapes)
+    all_gathers per step, NOT O(#leaves) (VERDICT r1 #3: the per-leaf loop
+    was ~300 collectives/step at GPT-base)."""
+    K = 8
+
+    def leaves(n_dense, n_bias):
+        p = {f"w{i}": np.zeros((K, 16, 8), np.float32)
+             for i in range(n_dense)}
+        p.update({f"b{i}": np.zeros((K, 8), np.float32)
+                  for i in range(n_bias)})
+        return p
+
+    small = _count_all_gathers(
+        DeMoStrategy(compression_topk=4, compression_chunk=8), K,
+        leaves(2, 2))
+    deep = _count_all_gathers(
+        DeMoStrategy(compression_topk=4, compression_chunk=8), K,
+        leaves(12, 12))
+    assert deep == small, (small, deep)  # depth-independent
+    # 2 signature groups → 2 gathers (HLO may split start/done pairs)
+    assert deep <= 4, deep
+
+
+def test_demo_recv_accounting():
+    """Both byte counters, matching reference demo_impl/demo.py:145-146,
+    187-190: receive = (K−1) × transmit for an all-gather exchange."""
+    K = 4
+    w0 = {"w": np.zeros((K, 8), np.float32)}
+    strat = DeMoStrategy(optim_spec=OptimSpec("sgd", lr=0.5),
+                         compression_topk=2, compression_chunk=8)
+    rt, step_fn, params, state = make_harness(strat, K, w0)
+    g = {"w": np.ones((K, 8), np.float32)}
+    _, _, m = step_fn(params, state, g, 0)
+    tx = float(m["comm_bytes"][0])
+    rx = float(m["comm_recv_bytes"][0])
+    assert tx == 2 * 8  # 1 chunk × 2 picks × 8 bytes
+    assert rx == (K - 1) * tx
+
+
+def test_demo_grouped_leaves_match_isolated_leaves():
+    """Concatenating leaves into one payload must not change any leaf's
+    update: a 2-leaf tree gives the same result per leaf as two 1-leaf
+    runs."""
+    K = 2
+    rng = np.random.default_rng(3)
+    wa = rng.normal(size=(K, 8)).astype(np.float32)
+    wb = rng.normal(size=(K, 16, 8)).astype(np.float32)
+    ga = rng.normal(size=(K, 8)).astype(np.float32)
+    gb = rng.normal(size=(K, 16, 8)).astype(np.float32)
+
+    def run(params0, grads):
+        strat = DeMoStrategy(optim_spec=OptimSpec("sgd", lr=0.1),
+                             compression_topk=2, compression_chunk=8)
+        rt, step_fn, params, state = make_harness(strat, K, params0)
+        p, s, _ = step_fn(params, state, grads, 0)
+        return jax.device_get(p)
+
+    both = run({"a": wa, "b": wb}, {"a": ga, "b": gb})
+    only_a = run({"a": wa}, {"a": ga})
+    only_b = run({"b": wb}, {"b": gb})
+    np.testing.assert_allclose(both["a"], only_a["a"], atol=1e-6)
+    np.testing.assert_allclose(both["b"], only_b["b"], atol=1e-6)
+
+
 def test_demo_trains_tiny_net():
     """Convergence smoke on the node mesh, K=4."""
     from gym_tpu import Trainer
