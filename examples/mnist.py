@@ -29,9 +29,11 @@ from gym_tpu.strategy import (DeMoStrategy, DiLoCoStrategy, FedAvgStrategy,
 
 
 def load_mnist(train: bool):
-    """torchvision MNIST with RandomAffine-equivalent augmentation left to
-    the caller (reference ``example/mnist.py:14-27``); falls back to a
-    synthetic digit-blob dataset offline."""
+    """Real digit images with translate augmentation (the reference trains
+    torchvision MNIST + RandomAffine, ``example/mnist.py:14-27``). Priority:
+    torchvision MNIST if a local copy exists → sklearn's bundled
+    handwritten-digits scans (REAL data, no download — see
+    ``gym_tpu/data/offline.py``) → synthetic blobs as a last resort."""
     try:
         from torchvision import datasets, transforms  # noqa
 
@@ -41,13 +43,19 @@ def load_mnist(train: bool):
         labels = ds.targets.numpy().astype(np.int32)
         return ArrayDataset(imgs, labels)
     except Exception:
+        pass
+    try:
+        from gym_tpu.data.offline import load_digits_mnist
+
+        return load_digits_mnist(train)
+    except Exception as e:
+        print(f"[examples/mnist] digits unavailable ({e}) -> synthetic")
         n = 8192 if train else 1024
         rng = np.random.default_rng(0 if train else 1)
         labels = rng.integers(0, 10, size=n).astype(np.int32)
         imgs = rng.normal(0, 0.1, size=(n, 28, 28, 1)).astype(np.float32)
         for i, y in enumerate(labels):
             imgs[i, (y * 2): (y * 2 + 6), 8:20, 0] += 1.2
-        print("[examples/mnist] torchvision MNIST unavailable -> synthetic")
         return ArrayDataset(imgs, labels)
 
 

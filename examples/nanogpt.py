@@ -93,7 +93,7 @@ def main():
     p = argparse.ArgumentParser()
     # dataset (reference :36-47)
     p.add_argument("--dataset", default="shakespeare",
-                   choices=["shakespeare", "wikitext", "code", "owt"])
+                   choices=["shakespeare", "wikitext", "code", "docs", "owt"])
     p.add_argument("--start_pc", type=float, default=0.0)
     p.add_argument("--end_pc", type=float, default=1.0)
     p.add_argument("--block_size", type=int, default=1024)

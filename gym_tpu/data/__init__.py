@@ -3,6 +3,8 @@ from .build_dataset import (build_dataset_owt, build_dataset_small,
 from .gpt_datasets import (ContiguousGPTTrainDataset,
                            LazyNonContiguousGPTTrainDataset,
                            NonContiguousGPTTrainDataset)
+from .offline import (CropAugmentedDataset, build_docs_corpus,
+                      load_digits_mnist)
 from .sampler import (ArrayDataset, IndexedDataset, NodeBatchIterator,
                       as_dataset, resolve_node_datasets)
 
@@ -10,4 +12,5 @@ __all__ = ["ArrayDataset", "IndexedDataset", "NodeBatchIterator",
            "as_dataset", "resolve_node_datasets", "get_dataset",
            "build_dataset_small", "build_dataset_owt", "generate_char_vocab",
            "char_vocab_size", "ContiguousGPTTrainDataset",
-           "NonContiguousGPTTrainDataset", "LazyNonContiguousGPTTrainDataset"]
+           "NonContiguousGPTTrainDataset", "LazyNonContiguousGPTTrainDataset",
+           "load_digits_mnist", "CropAugmentedDataset", "build_docs_corpus"]

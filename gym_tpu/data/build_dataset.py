@@ -141,9 +141,11 @@ def build_dataset_small(
     data_root: str = "data",
 ) -> Tuple[np.ndarray, int]:
     # "code" = BPE stream like wikitext, sourced from a code corpus
-    # (reference example/nanogpt.py offers the same dataset choice)
-    assert dataset in ("shakespeare", "wikitext", "code")
-    char = dataset == "shakespeare"
+    # (reference example/nanogpt.py offers the same dataset choice);
+    # "docs" = REAL English prose from installed package documentation —
+    # char-level, fully offline (gym_tpu/data/offline.py)
+    assert dataset in ("shakespeare", "wikitext", "code", "docs")
+    char = dataset in ("shakespeare", "docs")
     cache_dir = os.path.join(data_root,
                              f"{dataset}_char" if char else dataset)
     os.makedirs(cache_dir, exist_ok=True)
@@ -153,6 +155,14 @@ def build_dataset_small(
     vocab = char_vocab_size() if char else GPT2_VOCAB_SIZE
     if os.path.exists(cache):
         return np.load(cache), vocab
+
+    if dataset == "docs":
+        from .offline import build_docs_corpus
+        full = build_docs_corpus(data_root)
+        lo, hi = int(len(full) * start_pc), int(len(full) * end_pc)
+        data = full[lo:hi]
+        np.save(cache, data)
+        return data, vocab
 
     data = _try_hf_small(dataset, start_pc, end_pc)
     if data is None:

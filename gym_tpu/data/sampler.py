@@ -181,10 +181,28 @@ class NodeBatchIterator:
             for j in range(n_fields)
         )
 
+    def _unique_datasets(self):
+        seen, out = set(), []
+        for ds in self.datasets:
+            if id(ds) not in seen:
+                seen.add(id(ds))
+                out.append(ds)
+        return out
+
     def state(self) -> dict:
-        return {"epoch": self.epoch, "pos": list(self._pos)}
+        st = {"epoch": self.epoch, "pos": list(self._pos)}
+        ds_states = [
+            ds.state() if hasattr(ds, "state") else None
+            for ds in self._unique_datasets()
+        ]
+        if any(s is not None for s in ds_states):
+            st["datasets"] = ds_states
+        return st
 
     def load_state(self, st: dict):
         self.epoch = int(st["epoch"])
         self._reshuffle()
         self._pos = list(st["pos"])
+        for ds, s in zip(self._unique_datasets(), st.get("datasets", [])):
+            if s is not None and hasattr(ds, "load_state"):
+                ds.load_state(s)

@@ -29,6 +29,7 @@ PyTree = Any
 NODE_AXIS = "node"
 VNODE_AXIS = "vnode"
 SEQ_AXIS = "seq"
+MODEL_AXIS = "model"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -51,6 +52,11 @@ class AxisCtx:
     # (ring attention); gradients must be psum'd over them (train_node.py).
     seq_axes: tuple = ()
     seq_sizes: tuple = ()
+    # Tensor-parallel mesh axes (GSPMD-auto inside the node program): each
+    # node's network is Megatron-sharded over these. Strategies never see
+    # them — the partitioner inserts the collectives.
+    tp_axes: tuple = ()
+    tp_sizes: tuple = ()
 
     # -- collectives ------------------------------------------------------
 

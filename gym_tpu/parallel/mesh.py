@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .axis import NODE_AXIS, SEQ_AXIS, VNODE_AXIS, AxisCtx
+from .axis import MODEL_AXIS, NODE_AXIS, SEQ_AXIS, VNODE_AXIS, AxisCtx
 
 PyTree = Any
 
@@ -46,34 +46,49 @@ class NodeRuntime:
     n_virt: int   # V — simulated nodes folded per device (vmap)
     ctx: AxisCtx
     cp: int = 1   # context-parallel group size (devices per 'seq' axis)
+    tp: int = 1   # tensor-parallel group size (devices per 'model' axis)
 
     @classmethod
     def create(cls, num_nodes: int,
-               devices: Sequence[jax.Device] | None = None, cp: int = 1):
+               devices: Sequence[jax.Device] | None = None, cp: int = 1,
+               tp: int = 1):
         """``cp > 1`` adds a ``'seq'`` mesh axis: each simulated node's
         forward pass is context-parallel over ``cp`` devices (ring attention
-        over ICI, SURVEY §5.7 resolution). Mesh is [P, cp]; P·cp ≤ devices."""
+        over ICI, SURVEY §5.7 resolution). ``tp > 1`` adds a ``'model'``
+        mesh axis instead: each node's network is tensor-parallel over
+        ``tp`` devices — the axis stays GSPMD-*auto* (the body is manual
+        over ``'node'``/``'seq'`` only) so XLA partitions the matmuls from
+        ``with_sharding_constraint`` annotations and inserts the Megatron
+        collectives itself. Mesh is [P, cp?, tp?]; P·cp·tp ≤ devices."""
         if devices is None:
             devices = jax.devices()
-        assert len(devices) >= cp, (
-            f"cp={cp} does not fit {len(devices)} devices"
+        assert len(devices) >= cp * tp, (
+            f"cp={cp}×tp={tp} does not fit {len(devices)} devices"
         )
-        n_phys = _largest_divisor_at_most(num_nodes, len(devices) // cp)
+        n_phys = _largest_divisor_at_most(num_nodes,
+                                          len(devices) // (cp * tp))
         n_virt = num_nodes // n_phys
-        if cp == 1:
-            mesh = Mesh(np.asarray(devices[:n_phys]), (NODE_AXIS,))
-        else:
-            grid = np.asarray(devices[: n_phys * cp]).reshape(n_phys, cp)
-            mesh = Mesh(grid, (NODE_AXIS, SEQ_AXIS))
+        axes = [NODE_AXIS]
+        dims = [n_phys]
+        if cp > 1:
+            axes.append(SEQ_AXIS)
+            dims.append(cp)
+        if tp > 1:
+            axes.append(MODEL_AXIS)
+            dims.append(tp)
+        grid = np.asarray(devices[: int(np.prod(dims))]).reshape(dims)
+        mesh = Mesh(grid, tuple(axes))
         ctx = AxisCtx(
             num_nodes=num_nodes,
             axes=(NODE_AXIS, VNODE_AXIS),
             sizes=(n_phys, n_virt),
             seq_axes=(SEQ_AXIS,) if cp > 1 else (),
             seq_sizes=(cp,) if cp > 1 else (),
+            tp_axes=(MODEL_AXIS,) if tp > 1 else (),
+            tp_sizes=(tp,) if tp > 1 else (),
         )
         return cls(num_nodes=num_nodes, mesh=mesh, n_phys=n_phys,
-                   n_virt=n_virt, ctx=ctx, cp=cp)
+                   n_virt=n_virt, ctx=ctx, cp=cp, tp=tp)
 
     # -- sharding helpers -------------------------------------------------
 
@@ -112,6 +127,9 @@ class NodeRuntime:
         def block_fn(*args):
             return jax.vmap(node_fn, axis_name=VNODE_AXIS)(*args)
 
+        # manual over node/seq; the 'model' axis (if any) stays GSPMD-auto
+        manual = frozenset(self.mesh.axis_names) - {MODEL_AXIS}
+
         def program(*args):
             n_in = len(args)
             return jax.shard_map(
@@ -119,6 +137,7 @@ class NodeRuntime:
                 mesh=self.mesh,
                 in_specs=(P(NODE_AXIS),) * n_in,
                 out_specs=P(NODE_AXIS),
+                axis_names=manual,
                 check_vma=False,
             )(*args)
 

@@ -78,14 +78,25 @@ def _tree_paths(tree: PyTree):
     return paths, leaves, treedef
 
 
+def gpt_param_specs(params: PyTree) -> PyTree:
+    """Mesh-less ``PartitionSpec`` tree for a ``gym_tpu.models.nanogpt.GPT``
+    param tree (Megatron rules above) — usable both as jit shardings (with a
+    mesh) and as ``with_sharding_constraint`` specs inside the simulator's
+    hybrid node×model program (``NodeRuntime.create(tp=...)``)."""
+    paths, leaves, treedef = _tree_paths(params)
+    return jax.tree_util.tree_unflatten(
+        treedef,
+        [_spec_for_path(p, getattr(x, "ndim", 0))
+         for p, x in zip(paths, leaves)],
+    )
+
+
 def gpt_param_shardings(params: PyTree, mesh: Mesh) -> PyTree:
     """NamedSharding tree for a `gym_tpu.models.nanogpt.GPT` param tree."""
-    paths, leaves, treedef = _tree_paths(params)
-    shardings = [
-        NamedSharding(mesh, _spec_for_path(p, x.ndim))
-        for p, x in zip(paths, leaves)
-    ]
-    return jax.tree_util.tree_unflatten(treedef, shardings)
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), gpt_param_specs(params),
+        is_leaf=lambda x: isinstance(x, P),
+    )
 
 
 def fit_tensor_parallel(

@@ -32,8 +32,24 @@ class TrainState:
     rng: jax.Array               # per-node PRNG key
 
 
+def constrain_params(params: PyTree, param_specs) -> PyTree:
+    """Apply tensor-parallel ``with_sharding_constraint`` specs (a mesh-less
+    PartitionSpec tree, e.g. ``tensor_parallel.gpt_param_specs``) — no-op
+    when ``param_specs`` is None. Used under the hybrid node-manual /
+    model-auto program: GSPMD partitions the annotated matmuls and inserts
+    the Megatron collectives."""
+    if param_specs is None:
+        return params
+    import jax.sharding as shd
+    return jax.tree.map(
+        lambda x, s: jax.lax.with_sharding_constraint(x, s),
+        params, param_specs,
+        is_leaf=lambda x: isinstance(x, shd.PartitionSpec),
+    )
+
+
 def make_init_fn(loss_model: LossModel, strategy: Strategy, example_micro,
-                 seed: int):
+                 seed: int, param_specs=None):
     """Per-node state init. Params are built from the *same* seed on every
     node — replicas start identical by determinism, replacing the reference's
     initial broadcast from rank 0 (``train_node.py:101-104``). The dropout/
@@ -43,6 +59,7 @@ def make_init_fn(loss_model: LossModel, strategy: Strategy, example_micro,
     def init_fn(node_index: jnp.ndarray) -> TrainState:
         base = jax.random.PRNGKey(seed)
         params, model_state = loss_model.init(base, example_micro)
+        params = constrain_params(params, param_specs)
         return TrainState(
             params=params,
             model_state=model_state,
@@ -54,15 +71,24 @@ def make_init_fn(loss_model: LossModel, strategy: Strategy, example_micro,
     return init_fn
 
 
-def make_train_step(loss_model: LossModel, strategy: Strategy, ctx: AxisCtx):
+def make_train_step(loss_model: LossModel, strategy: Strategy, ctx: AxisCtx,
+                    param_specs=None):
     """Build ``node_step(state, batch) -> (state, metrics)``.
 
     ``batch`` leaves are [n_micro, micro_bs, ...]; the scan accumulates
     gradients and the sum is rescaled by n_micro, matching the reference's
     grad-accumulation loop and rescale (``train_node.py:157-171``).
+
+    ``param_specs``: tensor-parallel sharding constraints (see
+    ``constrain_params``); applied to params at step entry and exit so the
+    whole state (grads, opt state) inherits the Megatron layout.
     """
 
     def node_step(state: TrainState, batch) -> Tuple[TrainState, Dict]:
+        if param_specs is not None:
+            state = state.replace(
+                params=constrain_params(state.params, param_specs)
+            )
         step_rng = jax.random.fold_in(state.rng, state.step)
         if ctx.seq_axes:
             # decorrelate dropout across a node's sequence chunks
@@ -94,6 +120,7 @@ def make_train_step(loss_model: LossModel, strategy: Strategy, ctx: AxisCtx):
         params, sstate, metrics = strategy.step(
             grads, state.params, state.strategy_state, state.step, ctx
         )
+        params = constrain_params(params, param_specs)
         new_state = state.replace(
             params=params,
             model_state=model_state,
@@ -108,7 +135,7 @@ def make_train_step(loss_model: LossModel, strategy: Strategy, ctx: AxisCtx):
 
 
 def make_multi_train_step(loss_model: LossModel, strategy: Strategy,
-                          ctx: AxisCtx):
+                          ctx: AxisCtx, param_specs=None):
     """S training steps per dispatch: ``node_multi(state, batches)`` where
     batch leaves are [S, n_micro, micro_bs, ...]; returns metrics with a
     leading [S] axis.
@@ -120,7 +147,7 @@ def make_multi_train_step(loss_model: LossModel, strategy: Strategy,
     per-step strategy schedule (H gates, step counter) advances inside the
     scan.
     """
-    node_step = make_train_step(loss_model, strategy, ctx)
+    node_step = make_train_step(loss_model, strategy, ctx, param_specs)
 
     def node_multi(state: TrainState, batches):
         return jax.lax.scan(node_step, state, batches)

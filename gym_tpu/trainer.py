@@ -105,6 +105,7 @@ class Trainer:
         val_interval: int = 100,
         autocast: bool = False,
         cp: int = 1,
+        tp: int = 1,
         steps_per_call: int = 1,
         profile_dir: Optional[str] = None,
         checkpoint_interval: Optional[int] = None,
@@ -154,9 +155,12 @@ class Trainer:
                     "seq_axis='seq' (and attn_impl='ring') on the model "
                     "config, or drop the cp argument."
                 )
+        if cp > 1 and tp > 1:
+            raise ValueError("cp and tp cannot be combined yet")
         runtime = NodeRuntime.create(
-            num_nodes, _resolve_devices(device, devices), cp=cp
+            num_nodes, _resolve_devices(device, devices), cp=cp, tp=tp
         )
+
 
         train_dsets, train_sharded = resolve_node_datasets(
             self.train_dataset, num_nodes, is_val=False
@@ -186,7 +190,25 @@ class Trainer:
         ex = train_dsets[0].take(np.zeros(minibatch_size, dtype=np.int64))
         example_micro = jax.tree.map(lambda a: a[:minibatch_size], ex)
 
-        init_fn = make_init_fn(loss_model, strategy, example_micro, seed)
+        # Tensor parallelism: each simulated node's network is Megatron-
+        # sharded over the 'model' mesh axis via sharding constraints; the
+        # specs come from the model family's rules (GPT only for now).
+        param_specs = None
+        if tp > 1:
+            from .models.nanogpt import GPT as _GPT
+            from .parallel.tensor_parallel import gpt_param_specs
+            if not isinstance(loss_model.module, _GPT):
+                raise ValueError(
+                    "tp > 1 requires a model with tensor-parallel sharding "
+                    "rules (currently: GPT)"
+                )
+            shapes = jax.eval_shape(
+                lambda: loss_model.init(jax.random.PRNGKey(0), example_micro)
+            )
+            param_specs = gpt_param_specs(shapes[0])
+
+        init_fn = make_init_fn(loss_model, strategy, example_micro, seed,
+                               param_specs)
         state = runtime.init_state(init_fn)
 
         # Checkpoint/resume (the reference's disabled subsystem, SURVEY
@@ -201,12 +223,13 @@ class Trainer:
                 train_iter.load_state(data_state)
 
         train_step = runtime.compile(
-            make_train_step(loss_model, strategy, runtime.ctx)
+            make_train_step(loss_model, strategy, runtime.ctx, param_specs)
         )
         multi_step = None
         if steps_per_call > 1:
             multi_step = runtime.compile(
-                make_multi_train_step(loss_model, strategy, runtime.ctx)
+                make_multi_train_step(loss_model, strategy, runtime.ctx,
+                                      param_specs)
             )
         eval_step = runtime.compile(
             make_eval_step(loss_model, runtime.ctx), donate_state=False
@@ -224,7 +247,7 @@ class Trainer:
             "num_params": per_node_params,
             "model_config": _model_config(loss_model.module),
             "mesh": {"physical": runtime.n_phys, "virtual": runtime.n_virt,
-                     "cp": runtime.cp},
+                     "cp": runtime.cp, "tp": runtime.tp},
             **strategy.config(),
         }
 

@@ -1,0 +1,124 @@
+"""Offline REAL datasets (gym_tpu/data/offline.py): the discriminating
+baseline data (VERDICT r1 #2 — synthetic fallbacks saturate to 0.000)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from gym_tpu.data.offline import (CropAugmentedDataset, _upscale,
+                                  build_docs_corpus, load_digits_mnist)
+
+
+def test_upscale_bilinear_properties():
+    const = np.full((2, 8, 8), 3.5, np.float32)
+    up = _upscale(const, 28)
+    assert up.shape == (2, 28, 28)
+    np.testing.assert_allclose(up, 3.5, atol=1e-6)
+    # monotone ramp stays monotone and preserves range
+    ramp = np.tile(np.arange(8, dtype=np.float32)[None, :], (8, 1))[None]
+    up = _upscale(ramp, 28)
+    assert (np.diff(up[0], axis=1) >= -1e-6).all()
+    assert up.min() >= 0.0 and up.max() <= 7.0 + 1e-6
+
+
+def test_digits_loader_real_and_disjoint():
+    pytest.importorskip("sklearn")
+    tr = load_digits_mnist(True)
+    va = load_digits_mnist(False)
+    assert len(tr) + len(va) == 1797      # the full UCI digits set
+    x, y = va.take(np.arange(8))
+    assert x.shape == (8, 28, 28, 1) and x.dtype == np.float32
+    assert y.dtype == np.int32 and set(np.unique(y)) <= set(range(10))
+    # val images are deterministic; augmented train varies per call
+    np.testing.assert_array_equal(x, va.take(np.arange(8))[0])
+    a1, _ = tr.take(np.arange(8))
+    a2, _ = tr.take(np.arange(8))
+    assert not np.array_equal(a1, a2)
+    # augmentation translates, never invents content: per-sample sums are
+    # close (padding is background-valued)
+    assert isinstance(tr, CropAugmentedDataset)
+
+
+def test_digits_split_deterministic():
+    pytest.importorskip("sklearn")
+    a = load_digits_mnist(False)
+    b = load_digits_mnist(False)
+    xa, ya = a.take(np.arange(20))
+    xb, yb = b.take(np.arange(20))
+    np.testing.assert_array_equal(xa, xb)
+    np.testing.assert_array_equal(ya, yb)
+
+
+def test_docs_corpus_from_custom_root(tmp_path):
+    (tmp_path / "pkg").mkdir()
+    (tmp_path / "pkg" / "README.md").write_text(
+        "Hello world. " * 400)  # > min_bytes
+    (tmp_path / "pkg" / "mod.py").write_text(
+        '"""' + "A module docstring long enough to be harvested by the "
+        "corpus builder, with real English words. " * 60 + '"""\n'
+    )
+    out = build_docs_corpus(
+        data_root=str(tmp_path / "cache"), min_bytes=1024,
+        roots=(str(tmp_path),),
+    )
+    from gym_tpu.data.build_dataset import generate_char_vocab
+    char_int, eos = generate_char_vocab()
+    assert out.dtype == np.uint16
+    assert (out < 66).all()
+    assert (out == eos).sum() == 2        # one per source unit
+    # cache hit returns identical stream
+    again = build_docs_corpus(data_root=str(tmp_path / "cache"),
+                              roots=(str(tmp_path),))
+    np.testing.assert_array_equal(out, again)
+
+
+def test_get_dataset_docs_integration(tmp_path):
+    """The 'docs' dataset name flows through the standard selector."""
+    # point the corpus at a small custom root via monkeypatching the cache:
+    # build into the default data_root used by get_dataset
+    from gym_tpu.data import get_dataset
+    root = tmp_path / "data"
+    (tmp_path / "src").mkdir()
+    (tmp_path / "src" / "doc.md").write_text("The quick brown fox. " * 500)
+    import gym_tpu.data.offline as off
+    orig = off._DOC_ROOTS
+    off._DOC_ROOTS = (str(tmp_path / "src"),)
+    try:
+        ds, vocab = get_dataset("docs", block_size=32, data_root=str(root))
+    finally:
+        off._DOC_ROOTS = orig
+    assert vocab == 66
+    x, y = ds.take(np.array([0, 5]))
+    assert x.shape == (2, 32) and (y[:, :-1] == x[:, 1:]).all()
+
+
+def test_augmentation_stream_resumes_exactly():
+    """A resumed run must replay the exact augmentation crops of an
+    uninterrupted one (the checkpoint subsystem's bit-reproducibility)."""
+    pytest.importorskip("sklearn")
+    a = load_digits_mnist(True)
+    for _ in range(3):
+        a.take(np.arange(4))
+    snap = a.state()
+    x_next, _ = a.take(np.arange(4))
+
+    b = load_digits_mnist(True)
+    b.load_state(snap)
+    x_resumed, _ = b.take(np.arange(4))
+    np.testing.assert_array_equal(x_next, x_resumed)
+
+
+def test_mnist_example_uses_real_digits(monkeypatch):
+    pytest.importorskip("sklearn")
+    import importlib.util
+    import sys
+    # force the digits path even on machines with a torchvision MNIST copy
+    monkeypatch.setitem(sys.modules, "torchvision", None)
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "examples",
+                        "mnist.py")
+    spec = importlib.util.spec_from_file_location("_mnist_example", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    ds = mod.load_mnist(False)
+    assert len(ds) == 359      # sklearn digits val split, not synthetic
