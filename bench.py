@@ -61,7 +61,7 @@ def main() -> None:
     bf16 = os.environ.get("GYM_TPU_BENCH_BF16", "1") == "1"
     loss_model = LossModel(GPT(cfg), jnp.bfloat16 if bf16 else None)
 
-    spc = int(os.environ.get("GYM_TPU_BENCH_SPC", 10))
+    spc = int(os.environ.get("GYM_TPU_BENCH_SPC", 20))
     warm_calls = max(1, WARMUP // spc)
     timed_calls = max(1, TIMED // spc)
 

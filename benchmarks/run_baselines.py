@@ -4,8 +4,8 @@ Runs the five configurations the driver tracks (BASELINE.md):
   1. MNIST   2-node  SimpleReduce (AllReduce)
   2. MNIST   8-node  DiLoCo
   3. MNIST   8-node  SPARTA
-  4. nanoGPT 16-node FedAvg   (shakespeare-char)
-  5. nanoGPT 64-node DeMo     (shakespeare-char)
+  4. nanoGPT 16-node FedAvg   (docs-char: real offline English)
+  5. nanoGPT 64-node DeMo     (docs-char)
 
 and writes one JSON line per config plus `logs/baselines.json`.
 The reference's oracle is the same (SURVEY §4): final loss + it/s of the

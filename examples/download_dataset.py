@@ -1,0 +1,43 @@
+"""Pre-fetch and cache datasets (reference
+``example/nanogpt/download_dataset.py``): populate the ``data/`` token
+caches up front so training runs never touch the network.
+
+Online datasets (shakespeare / wikitext / code) use HuggingFace when
+reachable; everything falls back to the deterministic offline sources
+(``docs`` is always offline-real, ``owt`` materializes synthetic chunks).
+
+Usage:
+    python examples/download_dataset.py                # default set
+    python examples/download_dataset.py --datasets docs owt --block_size 256
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--datasets", nargs="+",
+                   default=["shakespeare", "docs"],
+                   choices=["shakespeare", "wikitext", "code", "docs", "owt"])
+    p.add_argument("--block_size", type=int, default=1024)
+    p.add_argument("--data_root", default="data")
+    args = p.parse_args()
+
+    from gym_tpu.data import get_dataset
+
+    for name in args.datasets:
+        ds, vocab = get_dataset(name, args.block_size,
+                                data_root=args.data_root)
+        print(f"{name}: {len(ds)} windows cached under "
+              f"{args.data_root}/ (vocab {vocab})")
+
+
+if __name__ == "__main__":
+    main()

@@ -37,11 +37,19 @@ def load_mnist(train: bool):
     try:
         from torchvision import datasets, transforms  # noqa
 
+        from gym_tpu.data.offline import CropAugmentedDataset
+
         ds = datasets.MNIST("data", train=train, download=False)
         imgs = (ds.data.numpy().astype(np.float32) / 255.0 - 0.1307) / 0.3081
-        imgs = imgs[..., None]
         labels = ds.targets.numpy().astype(np.int32)
-        return ArrayDataset(imgs, labels)
+        if train:
+            # same crop-translate augmentation as the digits path, so the
+            # baseline semantics do not depend on which corpus is present
+            pad = 3
+            padded = np.pad(imgs, ((0, 0), (pad, pad), (pad, pad)),
+                            constant_values=-0.1307 / 0.3081)
+            return CropAugmentedDataset(padded[..., None], labels, 28)
+        return ArrayDataset(imgs[..., None], labels)
     except Exception:
         pass
     try:

@@ -135,15 +135,21 @@ def _default_doc_roots() -> Tuple[str, ...]:
         roots.extend(site.getsitepackages())
     except Exception:  # pragma: no cover — venvs without getsitepackages
         pass
-    roots.append(os.path.join(os.path.dirname(os.path.dirname(os.__file__)),
-                              "site-packages"))
+    roots.append(os.path.join(os.path.dirname(os.__file__),
+                              "site-packages"))  # stdlib dir's sibling
     roots.extend(p for p in ("/opt/venv/lib", "/usr/lib/python3",
                              "/opt/skills") if os.path.isdir(p))
-    seen, out = set(), []
+    # keep each existing root once, and drop roots nested under an
+    # already-kept one (a recursive glob would walk that tree twice)
+    out = []
     for r in roots:
-        if r not in seen and os.path.isdir(r):
-            seen.add(r)
-            out.append(r)
+        r = os.path.abspath(r)
+        if not os.path.isdir(r):
+            continue
+        if any(r == k or r.startswith(k + os.sep) for k in out):
+            continue
+        out = [k for k in out if not k.startswith(r + os.sep)]
+        out.append(r)
     return tuple(out)
 
 
@@ -201,12 +207,19 @@ def build_docs_corpus(
     between source units) from installed packages' docs + docstrings.
     Cached as ``data/docs_char/stream.npy``; build is deterministic for a
     given installation (sorted walks)."""
+    import zlib
+
     from .build_dataset import generate_char_vocab
 
     if roots is None:
         roots = _DOC_ROOTS   # module attr, patchable in tests
     cache_dir = os.path.join(data_root, "docs_char")
-    cache = os.path.join(cache_dir, "stream.npy")
+    # cache key covers every argument that changes the corpus content —
+    # a roots/size change must not silently return a stale stream
+    key = zlib.crc32(
+        repr((tuple(roots), min_bytes, max_total_chars)).encode()
+    ) & 0xFFFFFFFF
+    cache = os.path.join(cache_dir, f"stream_{key:08x}.npy")
     if os.path.exists(cache):
         return np.load(cache)
 
