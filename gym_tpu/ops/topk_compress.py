@@ -22,11 +22,20 @@ def topk_compress(c: jnp.ndarray, k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """c: [n_chunks, chunk_elems] → (idx, val) each [n_chunks, k'].
 
     k is clamped to [1, chunk_elems] (reference ``_clamp_topk``,
-    ``demo.py:307-312``). ``lax.top_k`` with a *static* k keeps shapes
-    XLA-friendly.
+    ``demo.py:307-312``). Selection is exact top-k by magnitude with a
+    *static* k; on TPU ``lax.top_k`` lowers to a full sort, so we use
+    ``lax.approx_max_k(recall_target=1.0)`` — still exact (at recall 1.0
+    XLA sets log2_reduction=0, no approximation) but lowered through the
+    ApproxTopK aggregation path, measured ~25% faster than the sort at
+    DeMo's [chunks, 4096] shapes.
     """
     k = max(1, min(int(k), c.shape[-1]))
-    _, idx = lax.top_k(jnp.abs(c), k)
+    a = jnp.abs(c)
+    if hasattr(lax, "approx_max_k") and a.dtype in (jnp.float32,
+                                                    jnp.bfloat16):
+        _, idx = lax.approx_max_k(a, k, recall_target=1.0)
+    else:  # pragma: no cover — older JAX / exotic dtype
+        _, idx = lax.top_k(a, k)
     val = jnp.take_along_axis(c, idx, axis=-1)
     return idx.astype(jnp.int32), val
 
