@@ -100,6 +100,64 @@ class ChunkedDCT:
                        jnp.asarray(self.d_b, c.dtype))
         return x.reshape(self.shape)
 
+    def to_chunks(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Tensor in its natural shape → tile layout [n_chunks, a, b].
+
+        Pure data movement; lets codecs with the same (a, b) be concatenated
+        and transformed by ONE pair of basis matmuls (`encode_chunks`)
+        instead of one einsum per parameter."""
+        x = x.reshape(self.ya, self.a, self.xb, self.b)
+        return x.transpose(0, 2, 1, 3).reshape(self.n_chunks, self.a, self.b)
+
+    def from_chunks(self, c: jnp.ndarray) -> jnp.ndarray:
+        """Inverse of `to_chunks`: [n_chunks, a, b] → natural shape."""
+        c = c.reshape(self.ya, self.xb, self.a, self.b).transpose(0, 2, 1, 3)
+        return c.reshape(self.shape)
+
+
+def encode_chunks(chunks: jnp.ndarray, d_a, d_b) -> jnp.ndarray:
+    """Batched 2-D DCT: [G, a, b] tiles → [G, a·b] coefficients.
+
+    Same math as `ChunkedDCT.encode` but over tiles pooled from MANY
+    parameters (one matmul pair per chunk-shape signature instead of per
+    leaf — the MXU wants few big contractions, not ~150 small ones)."""
+    d_a = jnp.asarray(d_a, chunks.dtype)
+    d_b = jnp.asarray(d_b, chunks.dtype)
+    c = jnp.einsum("gab,ia,jb->gij", chunks, d_a, d_b)
+    return c.reshape(chunks.shape[0], -1)
+
+
+def decode_chunks(c: jnp.ndarray, d_a, d_b) -> jnp.ndarray:
+    """Inverse of `encode_chunks`: [G, a·b] → [G, a, b] tiles."""
+    d_a = jnp.asarray(d_a, c.dtype)
+    d_b = jnp.asarray(d_b, c.dtype)
+    cc = c.reshape(c.shape[0], d_a.shape[0], d_b.shape[0])
+    return jnp.einsum("gij,ia,jb->gab", cc, d_a, d_b)
+
+
+def sparse_decode_chunks(idx: jnp.ndarray, w: jnp.ndarray,
+                         d_a, d_b) -> jnp.ndarray:
+    """Decode m sparse 2-D DCT picks per tile straight to [G, a, b].
+
+    x[g] = Σ_u w[g,u] · Dₐ[i_u, :]ᵀ ⊗ D_b[j_u, :] with (i, j) = divmod(idx,
+    b) — i.e. gather the two basis rows each pick names and contract over
+    the pick axis (a batched [a,m]×[m,b] matmul). Equivalent to
+    scatter-add → dense [G, a·b] grid → `decode_chunks`, but never
+    materializes the grid: on the chip the dense route's scatters were
+    ~20% of the whole DeMo GPT-base step, the two gathers + small matmul
+    are ~1%. For duplicated indices pass the weights from
+    ``ops.topk_compress.mean_weights`` (w = slot_sum/cnt², so duplicates
+    of a slot sum to the slot MEAN) to reproduce the reference's
+    scatter-mean semantics; plain w = val is correct only when indices
+    are unique (own-picks residual path).
+    """
+    b = int(jnp.asarray(d_b).shape[0])
+    d_a = jnp.asarray(d_a, w.dtype)
+    d_b = jnp.asarray(d_b, w.dtype)
+    ra = jnp.take(d_a, idx // b, axis=0)     # [G, m, a]
+    rb = jnp.take(d_b, idx % b, axis=0)      # [G, m, b]
+    return jnp.einsum("gm,gma,gmb->gab", w, ra, rb)
+
 
 @functools.lru_cache(maxsize=None)
 def codec_for(shape: tuple, target_chunk: int) -> ChunkedDCT:

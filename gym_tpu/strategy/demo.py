@@ -30,8 +30,10 @@ from typing import Optional, Union
 import jax
 import jax.numpy as jnp
 
-from ..ops.dct import codec_for
-from ..ops.topk_compress import scatter_mean_decode, topk_compress
+from ..ops.dct import (codec_for, decode_chunks, dct_matrix, encode_chunks,
+                       sparse_decode_chunks)
+from ..ops.topk_compress import (mean_weights, scatter_mean_decode,
+                                 topk_compress)
 from .base import PyTree, Strategy
 from .optim import OptimSpec, ensure_optim_spec
 
@@ -67,9 +69,32 @@ class DeMoStrategy(Strategy):
     def _build(self):
         pass  # no optax transform: the update rule is DeMo itself
 
+    def _groups(self, p_leaves):
+        """codecs per leaf + tree-ordered leaf ids per (a, b) tile
+        signature. Leaves sharing a signature are processed as ONE
+        concatenated [G, a, b] tensor end to end."""
+        codecs = [codec_for(tuple(p.shape), self.compression_chunk)
+                  for p in p_leaves]
+        groups = {}
+        for i, c in enumerate(codecs):
+            groups.setdefault((c.a, c.b), []).append(i)
+        return codecs, dict(sorted(groups.items()))
+
     def init(self, params: PyTree) -> PyTree:
         assert self._finalized, "call strategy.finalize(max_steps) first"
-        return {"delta": jax.tree.map(jnp.zeros_like, params)}
+        # The momentum residual lives PRE-CHUNKED, pooled per tile
+        # signature ("{a}x{b}" → [G, a, b] f32), not in leaf layout: the
+        # whole momentum/DCT/top-k/residual pipeline then runs as a handful
+        # of big batched ops per step instead of ~6 small ops × n_leaves
+        # (profiled on the chip: the per-leaf loop was ~3k fusions/step at
+        # GPT-base, more wall time than the model's forward+backward).
+        p_leaves, _ = jax.tree.flatten(params)
+        codecs, groups = self._groups(p_leaves)
+        return {"delta": {
+            f"{a}x{b}": jnp.zeros(
+                (sum(codecs[i].n_chunks for i in ids), a, b), jnp.float32)
+            for (a, b), ids in groups.items()
+        }}
 
     def _lr(self, step):
         base = self.optim_spec.lr
@@ -85,75 +110,77 @@ class DeMoStrategy(Strategy):
 
         p_leaves, treedef = jax.tree.flatten(params)
         g_leaves = jax.tree.leaves(grads)
-        d_leaves = jax.tree.leaves(state["delta"])
-        codecs = [codec_for(tuple(p.shape), self.compression_chunk)
-                  for p in p_leaves]
+        codecs, groups = self._groups(p_leaves)
 
-        # Phase 1 (local, per leaf): momentum update + chunked DCT
-        # (reference demo.py:162-167). Top-k, residual correction, and the
-        # exchange are batched per chunk-shape signature below: the
-        # reference runs them per parameter (~150 sorts + ~300 collectives
-        # per step at GPT-base); here leaves with the same chunk_elems are
-        # concatenated along the chunk axis so the whole tree costs ONE
-        # top-k, ONE scatter and ONE packed all_gather per signature —
-        # profiled on the chip, per-leaf `lax.top_k` sorts alone were 37%
-        # of the DeMo-base step before this batching.
-        deltas = []
-        coeffs = []
-        for p, g, delta, codec in zip(p_leaves, g_leaves, d_leaves, codecs):
-            delta = (beta * delta.reshape(codec.shape)
-                     + lr * g.reshape(codec.shape))
-            deltas.append(delta)
-            coeffs.append(codec.encode(delta))
-
-        groups = {}
-        for i, codec in enumerate(codecs):
-            groups.setdefault(codec.chunk_elems, []).append(i)
-
-        new_delta_leaves = [None] * len(p_leaves)
-        decoded = [None] * len(p_leaves)
+        # Phases 1+2, batched per tile signature (the reference runs every
+        # phase per parameter — ~150 sorts + ~300 collectives per step at
+        # GPT-base, demo.py:119-180). Here the only per-leaf work is the
+        # layout shuffle of the incoming grads (`to_chunks`); momentum,
+        # DCT, top-k, residual correction, the packed all_gather and the
+        # decode each run ONCE per signature on the pooled [G, a, b]
+        # tensor. Profiled on the chip: this and the two-stage top-k
+        # (ops/topk_compress.py) took the DeMo-base step from 37%+ spent
+        # in per-leaf sorts to a handful of large ops.
+        new_delta = {}
+        decoded_chunks = {}
         comm_tx = 0.0
-        for chunk_elems, leaf_ids in sorted(groups.items()):
-            cat_c = jnp.concatenate([coeffs[i] for i in leaf_ids], axis=0)
-            cat_idx, cat_val = topk_compress(cat_c, topk)   # [G_chunks, k]
-            k = cat_idx.shape[-1]
+        for (a, b), leaf_ids in groups.items():
+            key = f"{a}x{b}"
+            d_a, d_b = dct_matrix(a), dct_matrix(b)
+            g_cat = jnp.concatenate(
+                [codecs[i].to_chunks(
+                    g_leaves[i].reshape(codecs[i].shape).astype(jnp.float32))
+                 for i in leaf_ids], axis=0)              # [G, a, b]
+            delta = beta * state["delta"][key] + lr * g_cat
+            coeffs = encode_chunks(delta, d_a, d_b)       # [G, a·b]
+            idx, val = topk_compress(coeffs, topk)        # [G, k]
+            k = idx.shape[-1]
             # residual correction: subtract own transmitted estimate
-            # (reference demo.py:170-180) — one scatter for the group
-            est_dense = scatter_mean_decode(cat_idx, cat_val, chunk_elems)
-            off = 0
-            for i in leaf_ids:
-                n = codecs[i].n_chunks
-                est = codecs[i].decode(est_dense[off:off + n])
-                new_delta_leaves[i] = (deltas[i] - est).reshape(
-                    p_leaves[i].shape)
-                off += n
+            # (reference demo.py:170-180). Own picks are distinct within a
+            # chunk (top-k), so mean == identity and the estimate decodes
+            # sparsely — no dense grid, no counts.
+            est = sparse_decode_chunks(idx, val, d_a, d_b)
+            new_delta[key] = delta - est
             # exchange: (val, idx-bitcast) packed into ONE f32 payload →
             # one all_gather per signature regardless of model depth
             payload = jnp.concatenate(
-                [cat_val.astype(jnp.float32),
-                 jax.lax.bitcast_convert_type(cat_idx, jnp.float32)], axis=-1
+                [val.astype(jnp.float32),
+                 jax.lax.bitcast_convert_type(idx, jnp.float32)], axis=-1
             )
-            gathered = ctx.all_gather(payload)     # [K, G_chunks, 2k]
+            gathered = ctx.all_gather(payload)     # [K, G, 2k]
             k_nodes = gathered.shape[0]
             g_val = gathered[..., :k]
             g_idx = jax.lax.bitcast_convert_type(gathered[..., k:], jnp.int32)
             # [K, G, k] → [G, K·k]: concat every node's picks per chunk
-            all_val = jnp.moveaxis(g_val, 0, -2).reshape(
-                cat_val.shape[0], k_nodes * k)
-            all_idx = jnp.moveaxis(g_idx, 0, -2).reshape(
-                cat_idx.shape[0], k_nodes * k)
-            dense = scatter_mean_decode(all_idx, all_val, chunk_elems)
-            off = 0
-            for i in leaf_ids:
-                n = codecs[i].n_chunks
-                decoded[i] = codecs[i].decode(dense[off:off + n])
-                off += n
-            comm_tx += float(cat_idx.shape[0] * k * 8)  # int32 idx + f32 val
+            all_val = jnp.moveaxis(g_val, 0, -2).reshape(idx.shape[0],
+                                                         k_nodes * k)
+            all_idx = jnp.moveaxis(g_idx, 0, -2).reshape(idx.shape[0],
+                                                         k_nodes * k)
+            # Concatenated picks may collide across nodes → scatter-MEAN.
+            # For modest pick counts the sparse decode (basis-row gather +
+            # batched matmul, FLOPs ∝ K·k) beats the dense grid scatter
+            # (cost ∝ chunk_elems, K-independent); past the crossover —
+            # and past `mean_weights`' O(m²) mask — the dense route wins,
+            # e.g. the 64-node configs.
+            if k_nodes * k <= 128:
+                w = mean_weights(all_idx, all_val)
+                decoded_chunks[key] = sparse_decode_chunks(all_idx, w,
+                                                           d_a, d_b)
+            else:
+                dense = scatter_mean_decode(all_idx, all_val, a * b)
+                decoded_chunks[key] = decode_chunks(dense, d_a, d_b)
+            comm_tx += float(idx.shape[0] * k * 8)  # int32 idx + f32 val
 
         # Phase 3 (local): sign-SGD with optional step-weight-decay
-        # (reference demo.py:159-160, 206-209).
+        # (reference demo.py:159-160, 206-209) — per leaf by necessity
+        # (params live per leaf), one fused elementwise pass each.
         new_params_leaves = []
-        for p, codec, dec in zip(p_leaves, codecs, decoded):
+        offsets = {key: 0 for key in new_delta}
+        for p, codec in zip(p_leaves, codecs):
+            key = f"{codec.a}x{codec.b}"
+            off, n = offsets[key], codec.n_chunks
+            dec = codec.from_chunks(decoded_chunks[key][off:off + n])
+            offsets[key] = off + n
             new_p = p.reshape(codec.shape)
             if self.weight_decay:
                 new_p = new_p * (1.0 - lr * self.weight_decay)
@@ -161,7 +188,6 @@ class DeMoStrategy(Strategy):
             new_params_leaves.append(new_p.reshape(p.shape).astype(p.dtype))
 
         new_params = jax.tree.unflatten(treedef, new_params_leaves)
-        new_delta = jax.tree.unflatten(treedef, new_delta_leaves)
         # both directions, matching the reference's data_transmit AND
         # data_receive counters (demo_impl/demo.py:145-146, 187-190)
         return (
