@@ -12,7 +12,9 @@ Usage (real TPU):
     python benchmarks/bench_gpt2_base.py --nodes 4 --attn flash --remat
 
 Prints one JSON line with it/s, tokens/s and MFU, and appends the result to
-``logs/bench_gpt2_base.jsonl``.
+``logs/bench_gpt2_base.jsonl``. ``measure()`` is importable — the repo-root
+``bench.py`` reuses it for its realistic-scale rider so the two published
+numbers can't drift.
 """
 
 from __future__ import annotations
@@ -25,6 +27,104 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 os.pardir))
+
+
+def measure(size: str = "base", nodes: int = 1, batch: int = 8,
+            block: int = 1024, attn: str = "flash", remat: bool = False,
+            bf16: bool = True, strategy: str = "diloco", steps: int = 20,
+            warmup: int = 3, spc: int = 5,
+            peak_tflops: float = 197.0) -> dict:
+    """Build the GPT-2 ``size`` model, run ``steps`` training steps with
+    ``strategy`` over ``nodes`` simulated nodes and return the measured
+    {it/s, MFU, tokens/s, loss, ...} dict. Raises on OOM/compile failure
+    — callers that must survive (bench.py's rider) catch."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gym_tpu.models.base import LossModel
+    from gym_tpu.models.nanogpt import GPT, GPTConfig, node_mfu
+    from gym_tpu.parallel.mesh import NodeRuntime
+    from gym_tpu.strategy.diloco import DiLoCoStrategy
+    from gym_tpu.strategy.simple_reduce import SimpleReduceStrategy
+    from gym_tpu.strategy.optim import OptimSpec
+    from gym_tpu.train_node import make_init_fn, make_multi_train_step
+
+    cfg = dataclasses.replace(
+        GPTConfig.gpt2_size_map(size),
+        block_size=block, dropout=0.0, attn_impl=attn, remat=remat,
+    )
+    loss_model = LossModel(GPT(cfg), jnp.bfloat16 if bf16 else None)
+
+    if strategy == "diloco":
+        strat = DiLoCoStrategy(optim_spec=OptimSpec("adamw", lr=3e-4), H=100)
+    elif strategy == "demo":
+        from gym_tpu.strategy.demo import DeMoStrategy
+        strat = DeMoStrategy(optim_spec=OptimSpec("sgd", lr=1e-3))
+    else:
+        strat = SimpleReduceStrategy(OptimSpec("adamw", lr=3e-4))
+
+    warm_calls = max(1, warmup // spc + (warmup % spc > 0))
+    timed_calls = max(1, steps // spc)
+    strat.finalize(max_steps=(warm_calls + timed_calls) * spc)
+
+    runtime = NodeRuntime.create(nodes, jax.devices())
+
+    rng = np.random.default_rng(0)
+    idx = rng.integers(
+        0, cfg.vocab_size,
+        (nodes, spc, 1, batch, cfg.block_size), dtype=np.int64,
+    )
+    batches = runtime.shard_batch((idx, np.roll(idx, -1, axis=-1)))
+
+    init_fn = make_init_fn(loss_model, strat,
+                           (idx[0, 0, 0], idx[0, 0, 0]), seed=42)
+    state = runtime.init_state(init_fn)
+    multi_step = runtime.compile(
+        make_multi_train_step(loss_model, strat, runtime.ctx)
+    )
+
+    t_compile = time.perf_counter()
+    for _ in range(warm_calls):
+        state, metrics = multi_step(state, batches)
+    # fetch a chained value as the execution fence (axon transport:
+    # block_until_ready resolves early; see .claude/skills/verify)
+    float(np.asarray(metrics["loss"]).sum())
+    t_compile = time.perf_counter() - t_compile
+
+    t0 = time.perf_counter()
+    for _ in range(timed_calls):
+        state, metrics = multi_step(state, batches)
+    loss = float(np.asarray(metrics["loss"]).mean())
+    dt = time.perf_counter() - t0
+
+    n_steps = timed_calls * spc
+    it_s = n_steps / dt
+    assert np.isfinite(loss), f"non-finite loss {loss}"
+
+    seqs_per_iter = batch * nodes
+    mfu = node_mfu(cfg, state.params, seqs_per_iter, dt / n_steps,
+                   peak_flops=peak_tflops * 1e12)
+
+    return {
+        "metric": f"gpt2_{size}_it_per_sec",
+        "value": round(it_s, 3),
+        "unit": "it/s",
+        "mfu": round(mfu, 4),
+        "tokens_per_sec": round(seqs_per_iter * cfg.block_size * it_s, 1),
+        "loss": round(loss, 4),
+        "nodes": nodes,
+        "batch_per_node": batch,
+        "block": cfg.block_size,
+        "attn": attn,
+        "remat": remat,
+        "bf16": bf16,
+        "strategy": strategy,
+        "warmup_s": round(t_compile, 1),
+        "platform": jax.devices()[0].platform,
+    }
 
 
 def main() -> None:
@@ -52,101 +152,14 @@ def main() -> None:
 
     if args.cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
-
-    import dataclasses
-
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
-    if args.cpu:
+        import jax
         jax.config.update("jax_platforms", "cpu")
 
-    from gym_tpu.models.base import LossModel
-    from gym_tpu.models.nanogpt import GPT, GPTConfig, node_mfu
-    from gym_tpu.parallel.mesh import NodeRuntime
-    from gym_tpu.strategy.diloco import DiLoCoStrategy
-    from gym_tpu.strategy.simple_reduce import SimpleReduceStrategy
-    from gym_tpu.strategy.optim import OptimSpec
-    from gym_tpu.train_node import make_init_fn, make_multi_train_step
-
-    cfg = dataclasses.replace(
-        GPTConfig.gpt2_size_map(args.size),
-        block_size=args.block, dropout=0.0,
-        attn_impl=args.attn, remat=args.remat,
-    )
-    loss_model = LossModel(GPT(cfg), None if args.no_bf16 else jnp.bfloat16)
-
-    if args.strategy == "diloco":
-        strategy = DiLoCoStrategy(optim_spec=OptimSpec("adamw", lr=3e-4),
-                                  H=100)
-    elif args.strategy == "demo":
-        from gym_tpu.strategy.demo import DeMoStrategy
-        strategy = DeMoStrategy(optim_spec=OptimSpec("sgd", lr=1e-3))
-    else:
-        strategy = SimpleReduceStrategy(OptimSpec("adamw", lr=3e-4))
-
-    spc = args.spc
-    warm_calls = max(1, args.warmup // spc + (args.warmup % spc > 0))
-    timed_calls = max(1, args.steps // spc)
-    strategy.finalize(max_steps=(warm_calls + timed_calls) * spc)
-
-    runtime = NodeRuntime.create(args.nodes, jax.devices())
-
-    rng = np.random.default_rng(0)
-    idx = rng.integers(
-        0, cfg.vocab_size,
-        (args.nodes, spc, 1, args.batch, args.block), dtype=np.int64,
-    )
-    batches = runtime.shard_batch((idx, np.roll(idx, -1, axis=-1)))
-
-    init_fn = make_init_fn(loss_model, strategy,
-                           (idx[0, 0, 0], idx[0, 0, 0]), seed=42)
-    state = runtime.init_state(init_fn)
-    multi_step = runtime.compile(
-        make_multi_train_step(loss_model, strategy, runtime.ctx)
-    )
-
-    t_compile = time.perf_counter()
-    for _ in range(warm_calls):
-        state, metrics = multi_step(state, batches)
-    # fetch a chained value as the execution fence (axon transport:
-    # block_until_ready resolves early; see .claude/skills/verify)
-    float(np.asarray(metrics["loss"]).sum())
-    t_compile = time.perf_counter() - t_compile
-
-    t0 = time.perf_counter()
-    for _ in range(timed_calls):
-        state, metrics = multi_step(state, batches)
-    loss = float(np.asarray(metrics["loss"]).mean())
-    dt = time.perf_counter() - t0
-
-    steps = timed_calls * spc
-    it_s = steps / dt
-    assert np.isfinite(loss), f"non-finite loss {loss}"
-
-    seqs_per_iter = args.batch * args.nodes
-    mfu = node_mfu(cfg, state.params, seqs_per_iter, dt / steps,
-                   peak_flops=args.peak_tflops * 1e12)
-    tokens_s = seqs_per_iter * args.block * it_s
-
-    result = {
-        "metric": f"gpt2_{args.size}_it_per_sec",
-        "value": round(it_s, 3),
-        "unit": "it/s",
-        "mfu": round(mfu, 4),
-        "tokens_per_sec": round(tokens_s, 1),
-        "loss": round(loss, 4),
-        "nodes": args.nodes,
-        "batch_per_node": args.batch,
-        "block": args.block,
-        "attn": args.attn,
-        "remat": args.remat,
-        "bf16": not args.no_bf16,
-        "strategy": args.strategy,
-        "warmup_s": round(t_compile, 1),
-        "platform": jax.devices()[0].platform,
-    }
+    result = measure(size=args.size, nodes=args.nodes, batch=args.batch,
+                     block=args.block, attn=args.attn, remat=args.remat,
+                     bf16=not args.no_bf16, strategy=args.strategy,
+                     steps=args.steps, warmup=args.warmup, spc=args.spc,
+                     peak_tflops=args.peak_tflops)
     print(json.dumps(result))
     out_dir = os.path.dirname(args.out)
     if out_dir:
