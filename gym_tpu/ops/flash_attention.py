@@ -68,12 +68,22 @@ def flash_causal_attention(
 
 def packed_flash_attention_or_none(q, k, v, n_head: int):
     """Packed-layout fast path: q/k/v [B, T, C] → output [B, T, C] with NO
-    head transposes, via the fused Pallas kernel. Returns None when the
-    kernel is not eligible (off-TPU, untileable T, dropout handled by the
-    caller) so the caller can take the standard [B, H, T, D] path. This is
-    THE dispatch point for packed eligibility — models must not
-    re-implement the platform/shape checks."""
-    from .fused_attention import fused_causal_attention_packed, packed_supported
+    head transposes, via a fused Pallas kernel. Returns None when neither
+    packed kernel is eligible (off-TPU, untileable T, dropout handled by
+    the caller) so the caller can take the standard [B, H, T, D] path.
+    This is THE dispatch point for packed eligibility — models must not
+    re-implement the platform/shape checks.
+
+    Measured alternative (rejected): a blocked-causal FA2 packed kernel
+    (q in bq-row blocks, k-loop bounded by the diagonal) that skips ~45%
+    of the score work. On the chip at GPT-2-base (T=1024, C=768) it loses
+    to the per-head whole-context kernel — 6.4 it/s (bq=256) / 7.2 (512)
+    vs 7.5 — because slicing 64-lane heads out of a 768-lane packed block
+    costs more than the causal skip saves. The [B, H, T, D] fallback path
+    below therefore stays the dispatch for shapes this packed kernel's
+    VMEM gate rejects."""
+    from .fused_attention import (fused_causal_attention_packed,
+                                  packed_supported)
     if not _on_tpu() or not packed_supported(q, n_head):
         return None
     return fused_causal_attention_packed(q, k, v, n_head)

@@ -58,11 +58,15 @@ def _bdot(a, b, dims, prec=jnp.float32):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale):
-    q = q_ref[:, 0].astype(jnp.float32)          # [bc, T, D]
-    k = k_ref[:, 0].astype(jnp.float32)
+    # dots take the inputs' native dtype (bf16 under autocast) and
+    # accumulate f32 via preferred_element_type — bit-identical to
+    # upcasting first (bf16×bf16 products are exact in f32) but runs the
+    # MXU at bf16 rate instead of f32 rate.
+    q = q_ref[:, 0]                              # [bc, T, D]
+    k = k_ref[:, 0]
     v = v_ref[:, 0]
     t = q.shape[1]
-    s = _bdot(q, k, (((2,), (2,)))) * scale      # [bc, T, T]
+    s = _bdot(q, k, (((2,), (2,)))) * scale      # [bc, T, T] f32
     s = jnp.where(_causal(t)[None], s, NEG)
     m = jnp.max(s, axis=2, keepdims=True)
     p = jnp.exp(s - m)
@@ -74,20 +78,25 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale):
 
 def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                 dq_ref, dk_ref, dv_ref, *, scale):
-    q = q_ref[:, 0].astype(jnp.float32)
-    k = k_ref[:, 0].astype(jnp.float32)
-    v = v_ref[:, 0].astype(jnp.float32)
-    o = o_ref[:, 0].astype(jnp.float32)
-    do = do_ref[:, 0].astype(jnp.float32)
+    # all five dots run at the inputs' native dtype (f32 accumulate);
+    # the recomputed probs p and the score gradient ds are cast back to
+    # that dtype before their dots — the flash-attention-2 convention
+    # (same precision class as the forward's (p/l).astype(v.dtype)).
+    q = q_ref[:, 0]
+    k = k_ref[:, 0]
+    v = v_ref[:, 0]
+    o = o_ref[:, 0]
+    do = do_ref[:, 0]
     lse = lse_ref[:, 0]                           # [bc, T, 1]
     t = q.shape[1]
     s = _bdot(q, k, ((2,), (2,))) * scale
     s = jnp.where(_causal(t)[None], s, NEG)
-    p = jnp.exp(s - lse)                          # normalized probs
-    dv = _bdot(p, do, ((1,), (1,)))               # [bc, T, D]
-    dp = _bdot(do, v, ((2,), (2,)))               # [bc, T, T]
-    delta = jnp.sum(do * o, axis=2, keepdims=True)
-    ds = p * (dp - delta) * scale
+    p = jnp.exp(s - lse)                          # normalized probs, f32
+    dv = _bdot(p.astype(do.dtype), do, ((1,), (1,)))   # [bc, T, D]
+    dp = _bdot(do, v, ((2,), (2,)))               # [bc, T, T] f32
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=2, keepdims=True)
+    ds = (p * (dp - delta) * scale).astype(q.dtype)
     dq = _bdot(ds, k, ((2,), (1,)))
     dk = _bdot(ds, q, ((1,), (1,)))
     dq_ref[:, 0] = dq.astype(dq_ref.dtype)
@@ -176,8 +185,9 @@ def packed_supported(q, n_head: int) -> bool:
     if not (fused_supported(q) and c % n_head == 0):
         return False
     bc = _packed_chunk(b, t)
-    # bwd live set: 8 packed tensors as f32 working copies + s/p/dp blocks
-    vmem = 8 * bc * t * c * 4 + 3 * bc * t * t * 4
+    # bwd live set: 8 packed tensors at the input dtype (the kernels dot
+    # at native dtype — no f32 working copies) + f32 s/p/dp score blocks
+    vmem = 8 * bc * t * c * q.dtype.itemsize + 3 * bc * t * t * 4
     return vmem <= 10 * 1024 * 1024
 
 
@@ -191,8 +201,8 @@ def packed_supported(q, n_head: int) -> bool:
 
 
 def _fwd_packed_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, nh):
-    q = q_ref[...].astype(jnp.float32)           # [bc, T, C]
-    k = k_ref[...].astype(jnp.float32)
+    q = q_ref[...]                               # [bc, T, C] native dtype
+    k = k_ref[...]
     v = v_ref[...]
     t, c = q.shape[1], q.shape[2]
     d = c // nh
@@ -213,11 +223,11 @@ def _fwd_packed_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, nh):
 
 def _bwd_packed_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                        dq_ref, dk_ref, dv_ref, *, scale, nh):
-    q = q_ref[...].astype(jnp.float32)
-    k = k_ref[...].astype(jnp.float32)
-    v = v_ref[...].astype(jnp.float32)
-    o = o_ref[...].astype(jnp.float32)
-    do = do_ref[...].astype(jnp.float32)
+    q = q_ref[...]                                # native dtype dots
+    k = k_ref[...]
+    v = v_ref[...]
+    o = o_ref[...]
+    do = do_ref[...]
     lse = lse_ref[...]                            # [bc, T, H]
     t, c = q.shape[1], q.shape[2]
     d = c // nh
@@ -230,10 +240,11 @@ def _bwd_packed_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         s = _bdot(qh, kh, ((2,), (2,))) * scale
         s = jnp.where(mask, s, NEG)
         p = jnp.exp(s - lse[:, :, h:h + 1])
-        dvs.append(_bdot(p, doh, ((1,), (1,))))
+        dvs.append(_bdot(p.astype(doh.dtype), doh, ((1,), (1,))))
         dp = _bdot(doh, vh, ((2,), (2,)))
-        delta = jnp.sum(doh * oh, axis=2, keepdims=True)
-        ds = p * (dp - delta) * scale
+        delta = jnp.sum(doh.astype(jnp.float32) * oh.astype(jnp.float32),
+                        axis=2, keepdims=True)
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
         dqs.append(_bdot(ds, kh, ((2,), (1,))))
         dks.append(_bdot(ds, qh, ((1,), (1,))))
     dq_ref[...] = jnp.concatenate(dqs, axis=2).astype(dq_ref.dtype)
@@ -312,3 +323,4 @@ def _vjp_bwd_packed(n_head, scale, res, do):
 
 
 fused_causal_attention_packed.defvjp(_vjp_fwd_packed, _vjp_bwd_packed)
+

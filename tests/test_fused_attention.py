@@ -61,6 +61,34 @@ def test_fused_grads_match_dense():
                                    err_msg=f"d{name} mismatch")
 
 
+def test_fused_bf16_matches_dense_bf16():
+    """The autocast path: kernels dot at native bf16 (f32 accumulate) and
+    cast p/ds back to bf16 — must track the dense bf16 path within bf16
+    noise. Covers the precision class the f32 tests can't see."""
+    q, k, v = _qkv(6, jnp.bfloat16)
+
+    def loss_fused(q, k, v):
+        return (fa.fused_causal_attention(q, k, v)
+                .astype(jnp.float32) ** 2).sum()
+
+    def loss_dense(q, k, v):
+        return (dense_causal_attention(q, k, v)
+                .astype(jnp.float32) ** 2).sum()
+
+    out = fa.fused_causal_attention(q, k, v)
+    ref = dense_causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=3e-2, rtol=3e-2)
+    g1 = jax.grad(loss_fused, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   atol=0.5, rtol=6e-2,
+                                   err_msg=f"d{name} mismatch")
+
+
 def _packed(seed=2):
     rng = np.random.default_rng(seed)
     return tuple(
