@@ -31,7 +31,8 @@ from gym_tpu.data import ContiguousGPTTrainDataset, get_dataset
 from gym_tpu.models.nanogpt import GPT, GPTConfig
 from gym_tpu.strategy import (DeMoStrategy, DiLoCoStrategy, FedAvgStrategy,
                               OptimSpec, SimpleReduceStrategy,
-                              SPARTADiLoCoStrategy, SPARTAStrategy)
+                              SPARTADiLoCoStrategy, SPARTAStrategy,
+                              ZeroReduceStrategy)
 
 
 def gen_run_name(args) -> str:
@@ -58,6 +59,10 @@ def create_strategy(args):
     )
     if args.strategy == "base":
         return SimpleReduceStrategy(optim_spec=optim, **sched)
+    if args.strategy == "zero":
+        # ZeRO-1 DDP (beyond the reference's strategy set): optimizer
+        # state sharded 1/K per node — see strategy/zero_reduce.py
+        return ZeroReduceStrategy(optim_spec=optim, **sched)
     if args.strategy == "fedavg":
         return FedAvgStrategy(inner_optim=optim, H=args.H,
                               island_size=args.island_size, **sched)
@@ -119,7 +124,7 @@ def main():
     p.add_argument("--val_interval", type=int, default=100)
     # strategy (:77-133)
     p.add_argument("--strategy", default="base",
-                   choices=["base", "fedavg", "diloco", "sparta",
+                   choices=["base", "zero", "fedavg", "diloco", "sparta",
                             "diloco_sparta", "demo"])
     p.add_argument("--H", type=int, default=1)
     p.add_argument("--island_size", type=int, default=None)

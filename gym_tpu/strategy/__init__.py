@@ -14,6 +14,7 @@ from .diloco import DiLoCoCommunicator, DiLoCoStrategy
 from .fedavg import AveragingCommunicator, FedAvgStrategy
 from .optim import OptimSpec, ensure_optim_spec
 from .simple_reduce import SimpleReduceStrategy
+from .zero_reduce import ZeroReduceStrategy
 from .sparta import (IndexSelector, PartitionedIndexSelector,
                      RandomIndexSelector, ShuffledSequentialIndexSelector,
                      SparseCommunicator, SPARTAStrategy)
@@ -24,6 +25,7 @@ __all__ = [
     "OptimSpec",
     "ensure_optim_spec",
     "SimpleReduceStrategy",
+    "ZeroReduceStrategy",
     "CommunicateOptimizeStrategy",
     "CommunicationModule",
     "DiLoCoStrategy",

@@ -77,6 +77,15 @@ class Strategy(abc.ABC):
         self._lr_scale = None
         self._lr_scale_host = None
         self._finalized = False
+        self._ctx = None
+
+    def bind_ctx(self, ctx) -> "Strategy":
+        """Attach the mesh context before ``init`` for strategies whose
+        state layout depends on the node count (e.g. ZeRO sharding).
+        ``make_init_fn(..., ctx=...)`` calls this; most strategies ignore
+        it."""
+        self._ctx = ctx
+        return self
 
     # -- lifecycle --------------------------------------------------------
 

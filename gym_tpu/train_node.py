@@ -49,12 +49,17 @@ def constrain_params(params: PyTree, param_specs) -> PyTree:
 
 
 def make_init_fn(loss_model: LossModel, strategy: Strategy, example_micro,
-                 seed: int, param_specs=None):
+                 seed: int, param_specs=None, ctx: AxisCtx = None):
     """Per-node state init. Params are built from the *same* seed on every
     node — replicas start identical by determinism, replacing the reference's
     initial broadcast from rank 0 (``train_node.py:101-104``). The dropout/
     data RNG is folded with the node index so noise decorrelates across
-    nodes."""
+    nodes.
+
+    ``ctx``: pass ``runtime.ctx`` for strategies whose state layout depends
+    on the mesh (ZeRO sharding); harmless otherwise."""
+    if ctx is not None:
+        strategy.bind_ctx(ctx)
 
     def init_fn(node_index: jnp.ndarray) -> TrainState:
         base = jax.random.PRNGKey(seed)

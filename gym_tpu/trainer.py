@@ -208,7 +208,7 @@ class Trainer:
             param_specs = gpt_param_specs(shapes[0])
 
         init_fn = make_init_fn(loss_model, strategy, example_micro, seed,
-                               param_specs)
+                               param_specs, ctx=runtime.ctx)
         state = runtime.init_state(init_fn)
 
         # Checkpoint/resume (the reference's disabled subsystem, SURVEY
