@@ -60,6 +60,9 @@ def measure(size: str = "base", nodes: int = 1, batch: int = 8,
 
     if strategy == "diloco":
         strat = DiLoCoStrategy(optim_spec=OptimSpec("adamw", lr=3e-4), H=100)
+    elif strategy == "zero":
+        from gym_tpu.strategy.zero_reduce import ZeroReduceStrategy
+        strat = ZeroReduceStrategy(OptimSpec("adamw", lr=3e-4))
     elif strategy == "demo":
         from gym_tpu.strategy.demo import DeMoStrategy
         strat = DeMoStrategy(optim_spec=OptimSpec("sgd", lr=1e-3))
@@ -80,7 +83,8 @@ def measure(size: str = "base", nodes: int = 1, batch: int = 8,
     batches = runtime.shard_batch((idx, np.roll(idx, -1, axis=-1)))
 
     init_fn = make_init_fn(loss_model, strat,
-                           (idx[0, 0, 0], idx[0, 0, 0]), seed=42)
+                           (idx[0, 0, 0], idx[0, 0, 0]), seed=42,
+                           ctx=runtime.ctx)
     state = runtime.init_state(init_fn)
     multi_step = runtime.compile(
         make_multi_train_step(loss_model, strat, runtime.ctx)
@@ -139,7 +143,7 @@ def main() -> None:
     ap.add_argument("--remat", action="store_true")
     ap.add_argument("--no-bf16", action="store_true")
     ap.add_argument("--strategy", default="diloco",
-                    choices=["diloco", "simple", "demo"])
+                    choices=["diloco", "simple", "demo", "zero"])
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--spc", type=int, default=5,
