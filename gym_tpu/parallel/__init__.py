@@ -1,9 +1,13 @@
 from .axis import (AxisCtx, NODE_AXIS, SEQ_AXIS, VNODE_AXIS,
                    single_node_ctx)
 from .mesh import NodeRuntime
+from .pipeline import (PIPE_AXIS, apply_stage_layers, pipeline_apply,
+                       stack_stage_params)
 from .multihost import initialize as initialize_multihost, is_primary
 from .ring_attention import ring_causal_attention
 
 __all__ = ["AxisCtx", "NodeRuntime", "NODE_AXIS", "VNODE_AXIS", "SEQ_AXIS",
            "single_node_ctx", "ring_causal_attention",
-           "initialize_multihost", "is_primary"]
+           "initialize_multihost", "is_primary",
+           "PIPE_AXIS", "pipeline_apply", "stack_stage_params",
+           "apply_stage_layers"]
