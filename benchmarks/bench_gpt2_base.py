@@ -33,7 +33,7 @@ def measure(size: str = "base", nodes: int = 1, batch: int = 8,
             block: int = 1024, attn: str = "flash", remat: bool = False,
             bf16: bool = True, strategy: str = "diloco", steps: int = 20,
             warmup: int = 3, spc: int = 5,
-            peak_tflops: float = 197.0) -> dict:
+            peak_tflops: float = 197.0, shard_outer: bool = False) -> dict:
     """Build the GPT-2 ``size`` model, run ``steps`` training steps with
     ``strategy`` over ``nodes`` simulated nodes and return the measured
     {it/s, MFU, tokens/s, loss, ...} dict. Raises on OOM/compile failure
@@ -59,7 +59,8 @@ def measure(size: str = "base", nodes: int = 1, batch: int = 8,
     loss_model = LossModel(GPT(cfg), jnp.bfloat16 if bf16 else None)
 
     if strategy == "diloco":
-        strat = DiLoCoStrategy(optim_spec=OptimSpec("adamw", lr=3e-4), H=100)
+        strat = DiLoCoStrategy(optim_spec=OptimSpec("adamw", lr=3e-4),
+                               H=100, shard_outer=shard_outer)
     elif strategy == "zero":
         from gym_tpu.strategy.zero_reduce import ZeroReduceStrategy
         strat = ZeroReduceStrategy(OptimSpec("adamw", lr=3e-4))
@@ -125,7 +126,8 @@ def measure(size: str = "base", nodes: int = 1, batch: int = 8,
         "attn": attn,
         "remat": remat,
         "bf16": bf16,
-        "strategy": strategy,
+        "strategy": strategy + ("+shard_outer" if shard_outer
+                                and strategy == "diloco" else ""),
         "warmup_s": round(t_compile, 1),
         "platform": jax.devices()[0].platform,
     }
@@ -141,6 +143,8 @@ def main() -> None:
     ap.add_argument("--block", type=int, default=1024)
     ap.add_argument("--attn", default="flash", choices=["dense", "flash"])
     ap.add_argument("--remat", action="store_true")
+    ap.add_argument("--shard-outer", action="store_true",
+                    help="DiLoCo: ZeRO-shard the outer master/momentum")
     ap.add_argument("--no-bf16", action="store_true")
     ap.add_argument("--strategy", default="diloco",
                     choices=["diloco", "simple", "demo", "zero"])
@@ -163,7 +167,8 @@ def main() -> None:
                      block=args.block, attn=args.attn, remat=args.remat,
                      bf16=not args.no_bf16, strategy=args.strategy,
                      steps=args.steps, warmup=args.warmup, spc=args.spc,
-                     peak_tflops=args.peak_tflops)
+                     peak_tflops=args.peak_tflops,
+                     shard_outer=args.shard_outer)
     print(json.dumps(result))
     out_dir = os.path.dirname(args.out)
     if out_dir:

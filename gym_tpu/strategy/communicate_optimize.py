@@ -28,6 +28,12 @@ from .optim import OptimSpec, ensure_optim_spec
 class CommunicationModule(abc.ABC):
     """Pure communication transformer over the node axis."""
 
+    _ctx = None  # mesh context, bound before init for layout decisions
+
+    def bind_ctx(self, ctx) -> "CommunicationModule":
+        self._ctx = ctx
+        return self
+
     def init(self, params: PyTree) -> PyTree:
         return {}
 
@@ -60,6 +66,12 @@ class CommunicateOptimizeStrategy(Strategy):
 
     def _build(self):
         self.tx = self.optim_spec.build(self._lr_scale)
+
+    def bind_ctx(self, ctx):
+        super().bind_ctx(ctx)
+        for m in self.communication_modules:
+            m.bind_ctx(ctx)
+        return self
 
     def init(self, params: PyTree) -> PyTree:
         assert self._finalized, "call strategy.finalize(max_steps) first"

@@ -31,17 +31,29 @@ from .base import PyTree, tree_bytes
 from .communicate_optimize import (CommunicateOptimizeStrategy,
                                    CommunicationModule)
 from .optim import OptimSpec, ensure_optim_spec
+from .sharding import take_shard, unshard
 
 
 class DiLoCoCommunicator(CommunicationModule):
-    """Outer-loop model averaging + replicated Nesterov outer step."""
+    """Outer-loop model averaging + replicated Nesterov outer step.
+
+    ``shard_outer=True`` stores each node's 1/K slice of the (otherwise
+    bit-identical, replicated) master params + outer momentum — ZeRO
+    applied to the OUTER optimizer. Valid because the outer step's input
+    (the psum average) is identical on every node, so slicing commutes
+    with the elementwise Nesterov update. Cuts the outer state from
+    2·|θ| per node to 2·|θ|/K (at GPT-2 base × 4 nodes: 4 GB → 1 GB
+    total), at the cost of an extra all_gather per outer round
+    (3(K−1)/K·|θ| per H steps instead of 2(K−1)/K·|θ|)."""
 
     def __init__(
         self,
         H: int = 100,
         outer_optim_spec: Optional[Union[str, OptimSpec]] = None,
+        shard_outer: bool = False,
     ):
         self.H = int(H)
+        self.shard_outer = bool(shard_outer)
         self.outer_optim_spec = ensure_optim_spec(
             outer_optim_spec,
             OptimSpec("sgd", lr=0.7, nesterov=True, momentum=0.9),
@@ -49,16 +61,29 @@ class DiLoCoCommunicator(CommunicationModule):
         self.outer_tx = self.outer_optim_spec.build()
 
     def init(self, params: PyTree) -> PyTree:
-        return {
-            "master": jax.tree.map(jnp.array, params),
-            "outer_opt": self.outer_tx.init(params),
-        }
+        if not self.shard_outer:
+            return {
+                "master": jax.tree.map(jnp.array, params),
+                "outer_opt": self.outer_tx.init(params),
+            }
+        assert self._ctx is not None, (
+            "shard_outer=True needs the mesh: pass ctx to make_init_fn "
+            "(the Trainer does) or call strategy.bind_ctx(runtime.ctx)"
+        )
+        # init runs inside the node program (NodeRuntime.init_state), so
+        # the node index is live and each node keeps only its own slice.
+        # Dtype follows the params (sharding.take_shard), so the sharded
+        # Nesterov arithmetic is comparable with the replicated path for
+        # any parameter dtype.
+        my, _, _ = take_shard(params, self._ctx.num_nodes,
+                              self._ctx.node_index())
+        return {"master": my, "outer_opt": self.outer_tx.init(my)}
 
     def communicate(self, params, mstate, step, ctx):
         k = ctx.num_nodes
         psize = float(tree_bytes(params))
 
-        def outer(params, mstate):
+        def outer_replicated(params, mstate):
             avg = ctx.pmean(params)
             master = mstate["master"]
             # outer pseudo-gradient: master − averaged (reference :43-45)
@@ -72,16 +97,33 @@ class DiLoCoCommunicator(CommunicationModule):
             comm = jnp.asarray(2.0 * (k - 1) / max(k, 1) * psize)
             return master, {"master": master, "outer_opt": outer_opt}, comm
 
+        def outer_sharded(params, mstate):
+            avg = ctx.pmean(params)
+            avg_my, unravel, n = take_shard(avg, k, ctx.node_index())
+            pseudo = mstate["master"] - avg_my
+            updates, outer_opt = self.outer_tx.update(
+                pseudo, mstate["outer_opt"], mstate["master"]
+            )
+            master = optax.apply_updates(mstate["master"], updates)
+            new_params = unshard(ctx, master, n, unravel)
+            comm = jnp.asarray(3.0 * (k - 1) / max(k, 1) * psize)
+            return (new_params,
+                    {"master": master, "outer_opt": outer_opt}, comm)
+
         def skip(params, mstate):
             return params, mstate, jnp.zeros(())
 
+        outer = outer_sharded if self.shard_outer else outer_replicated
         do = jnp.logical_and(step % self.H == 0, step > 0)
         return jax.lax.cond(do, outer, skip, params, mstate)
 
     def config(self):
-        return {"module": "DiLoCoCommunicator", "H": self.H,
-                "outer_optimizer": self.outer_optim_spec.name,
-                "outer_lr": self.outer_optim_spec.lr}
+        cfg = {"module": "DiLoCoCommunicator", "H": self.H,
+               "outer_optimizer": self.outer_optim_spec.name,
+               "outer_lr": self.outer_optim_spec.lr}
+        if self.shard_outer:
+            cfg["shard_outer"] = True
+        return cfg
 
 
 class DiLoCoStrategy(CommunicateOptimizeStrategy):
@@ -97,11 +139,13 @@ class DiLoCoStrategy(CommunicateOptimizeStrategy):
         max_norm: Optional[float] = None,
         lr_scheduler=None,
         lr_scheduler_kwargs=None,
+        shard_outer: bool = False,
     ):
         self.H = int(H)
         super().__init__(
             communication_modules=[
-                DiLoCoCommunicator(H=H, outer_optim_spec=outer_optim_spec)
+                DiLoCoCommunicator(H=H, outer_optim_spec=outer_optim_spec,
+                                   shard_outer=shard_outer)
             ],
             inner_optim=ensure_optim_spec(optim_spec, OptimSpec("adamw")),
             max_norm=max_norm,

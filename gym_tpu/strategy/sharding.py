@@ -1,0 +1,44 @@
+"""Flat-vector 1/K sharding helpers shared by the ZeRO-style strategies.
+
+Used by `ZeroReduceStrategy` (shards the whole optimizer state) and
+`DiLoCoCommunicator(shard_outer=True)` (shards the outer master/momentum):
+a pytree is raveled to one flat vector, zero-padded to `K·shard`, and each
+node keeps the `shard`-sized slice at its linear node index; `unshard`
+reassembles the full tree with one all_gather. Dtype follows the pytree
+(`ravel_pytree`'s promotion), so sharded arithmetic is bit-comparable to
+its replicated equivalent.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.flatten_util import ravel_pytree
+
+PyTree = Any
+
+
+def shard_size(params: PyTree, k: int) -> int:
+    """ceil(total params / K) — the last shard is zero-padded."""
+    n = sum(x.size for x in jax.tree.leaves(params))
+    return -(-n // k)
+
+
+def take_shard(tree: PyTree, k: int, index) -> Tuple[jnp.ndarray, Any, int]:
+    """Ravel `tree`, pad to K·shard, return (this node's slice, unravel
+    fn, unpadded length). `index` is the node's linear index (traced)."""
+    flat, unravel = ravel_pytree(tree)
+    n = flat.size
+    shard = shard_size(tree, k)
+    flat = jnp.pad(flat, (0, k * shard - n))
+    return lax.dynamic_slice(flat, (index * shard,), (shard,)), unravel, n
+
+
+def unshard(ctx, my_shard: jnp.ndarray, n: int, unravel) -> PyTree:
+    """Reassemble the full tree from every node's slice (one all_gather,
+    ordered by linear node index — matches `take_shard`'s slicing)."""
+    gathered = ctx.all_gather(my_shard)          # [K, shard]
+    return unravel(gathered.reshape(-1)[:n])

@@ -34,6 +34,7 @@ from jax.flatten_util import ravel_pytree
 
 from .base import PyTree, Strategy, tree_bytes
 from .optim import OptimSpec, ensure_optim_spec
+from .sharding import shard_size, unshard
 
 
 class ZeroReduceStrategy(Strategy):
@@ -51,11 +52,6 @@ class ZeroReduceStrategy(Strategy):
     def _build(self):
         self.tx = self.optim_spec.build(self._lr_scale)
 
-    @staticmethod
-    def _shard_size(params: PyTree, k: int) -> int:
-        n = sum(x.size for x in jax.tree.leaves(params))
-        return -(-n // k)  # ceil: last shard is zero-padded
-
     def init(self, params: PyTree) -> PyTree:
         assert self._finalized, "call strategy.finalize(max_steps) first"
         assert self._ctx is not None, (
@@ -64,14 +60,14 @@ class ZeroReduceStrategy(Strategy):
             "(the Trainer does) or call strategy.bind_ctx(runtime.ctx)."
         )
         shard = jnp.zeros(
-            (self._shard_size(params, self._ctx.num_nodes),), jnp.float32)
+            (shard_size(params, self._ctx.num_nodes),), jnp.float32)
         return {"opt": self.tx.init(shard)}
 
     def step(self, grads, params, state, step, ctx):
         # shard size from the step ctx (init's bound ctx must agree — the
         # opt-state shapes pin it, so a mismatched K fails loudly in optax)
         k = ctx.num_nodes
-        shard = self._shard_size(params, k)
+        shard = shard_size(params, k)
         flat_g, _ = ravel_pytree(grads)
         flat_p, unravel = ravel_pytree(params)
         pad = k * shard - flat_g.size
@@ -91,9 +87,9 @@ class ZeroReduceStrategy(Strategy):
         p_my = optax.apply_updates(p_my, updates)
 
         # re-assemble the full parameter vector from every node's slice
-        gathered = ctx.all_gather(p_my)            # [K, shard]
-        new_flat = gathered.reshape(-1)[: flat_p.size]
-        new_params = unravel(new_flat.astype(flat_p.dtype))
+        new_params = jax.tree.map(
+            lambda x, p: x.astype(p.dtype),
+            unshard(ctx, p_my, flat_p.size, unravel), params)
 
         comm = ((k - 1) / max(k, 1)
                 * (2.0 * tree_bytes(grads) + tree_bytes(params)))
