@@ -146,6 +146,13 @@ def main():
                    choices=[None, "dense", "flash", "ring"])
     p.add_argument("--autocast", action="store_true",
                    help="bf16 forward pass")
+    p.add_argument("--n_experts", type=int, default=0,
+                   help="MoE: experts per MoE block (0 = dense)")
+    p.add_argument("--expert_topk", type=int, default=2)
+    p.add_argument("--moe_every", type=int, default=2,
+                   help="every Nth block is MoE (2 = alternate)")
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel devices (shards experts)")
     args = p.parse_args()
 
     attn = args.attn_impl or ("ring" if args.cp > 1 else "dense")
@@ -180,6 +187,11 @@ def main():
     cfg.block_size = args.block_size
     cfg.attn_impl = attn
     cfg.seq_axis = "seq" if attn == "ring" else None
+    if args.n_experts:
+        cfg.n_experts = args.n_experts
+        cfg.expert_topk = args.expert_topk
+        cfg.moe_every = args.moe_every
+        cfg.expert_axis = "expert" if args.ep > 1 else None
 
     res = Trainer(GPT(cfg), train_data, val_data).fit(
         num_epochs=args.num_epochs,
@@ -190,6 +202,7 @@ def main():
         batch_size=args.batch_size,
         minibatch_size=args.minibatch_size,
         cp=args.cp,
+        ep=args.ep,
         autocast=args.autocast,
         seed=args.seed,
         val_size=args.val_size,

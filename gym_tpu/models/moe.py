@@ -1,0 +1,182 @@
+"""Mixture-of-Experts layer + expert parallelism (beyond-reference).
+
+The reference framework has no MoE / expert parallelism anywhere
+(SURVEY §2.3: EP row ❌ — its model zoo is dense nanoGPT,
+``example/nanogpt/nanogpt.py:104-123`` MLP only). This module closes that
+row the TPU way: a GShard/Switch-style token-choice router with **static
+capacity** (no data-dependent shapes — XLA requirement), dispatch/combine as
+one-hot einsums (MXU-friendly), and expert parallelism as a GSPMD-auto
+``'expert'`` mesh axis — expert-stacked params carry
+``P('expert', ...)`` sharding constraints and XLA inserts the all-to-alls,
+the same recipe as the tensor-parallel path
+(``gym_tpu/parallel/tensor_parallel.py``).
+
+Design notes (TPU-first):
+- Router math in f32 even under bf16 autocast (softmax/cumsum stability).
+- top-k selection is a static K-iteration loop of argmax+mask (K ≤ 2 in
+  practice) — no sorts, no dynamic shapes.
+- Position-in-expert via cumsum over the flattened token axis; tokens past
+  an expert's capacity are *dropped* (their combine weight is 0 and the
+  residual connection carries them through) — standard Switch semantics.
+- Load-balance aux loss (Switch Transformer eq. 4): ``E · Σ_e f_e · p_e``
+  over the top-1 routing fraction f and mean router prob p, plus a router
+  z-loss; both are returned from the layer and folded into the training
+  loss by the model (weighted by ``GPTConfig.moe_aux_weight`` /
+  ``moe_z_weight``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..parallel.axis import EXPERT_AXIS
+
+PyTree = Any
+
+
+def _init_normal(std: float):
+    return nn.initializers.normal(stddev=std)
+
+
+def _constrain(x, spec):
+    """``with_sharding_constraint`` that is a no-op under mesh-less tracing
+    (unit tests without a mesh context) but fails loudly on a real
+    misconfiguration (e.g. an axis name missing from the mesh)."""
+    if jax.sharding.get_abstract_mesh().empty:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, jax.sharding.PartitionSpec(*spec)
+    )
+
+
+class MoEMLP(nn.Module):
+    """Drop-in replacement for the GPT ``MLP``: E experts, top-k routing.
+
+    ``__call__(x, train) -> (y, aux)`` where ``y`` has ``x``'s shape and
+    ``aux`` is the *weighted* auxiliary loss (balance + z), a f32 scalar.
+    """
+
+    n_embd: int
+    n_layer: int
+    n_experts: int
+    topk: int = 2
+    capacity_factor: float = 1.25
+    dropout: float = 0.0
+    bias: bool = True
+    aux_weight: float = 1e-2
+    z_weight: float = 1e-3
+    expert_axis: Optional[str] = None  # mesh axis name for EP (GSPMD-auto)
+
+    @nn.compact
+    def __call__(self, x, train: bool) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        E, K = self.n_experts, self.topk
+        assert 1 <= K <= E, f"topk={K} must be in [1, n_experts={E}]"
+        B, T, C = x.shape
+        S = B * T
+        hid = 4 * C
+        xf = x.reshape(S, C)
+
+        # -- router (f32) --------------------------------------------------
+        logits = nn.Dense(
+            E, use_bias=False, kernel_init=_init_normal(0.02), name="router",
+        )(xf).astype(jnp.float32)
+        gates = jax.nn.softmax(logits, axis=-1)                    # [S, E]
+
+        capacity = min(int(math.ceil(self.capacity_factor * S * K / E)), S)
+
+        # -- static top-k assignment with capacity -------------------------
+        remaining = gates
+        offset = jnp.zeros((E,), jnp.int32)      # slots used by earlier k
+        dispatch = jnp.zeros((S, E, capacity), jnp.float32)
+        combine = jnp.zeros((S, E, capacity), jnp.float32)
+        gate_sum = jnp.zeros((S,), jnp.float32)
+        top1_mask = None
+        for k in range(K):
+            idx_k = jnp.argmax(remaining, axis=-1)                 # [S]
+            mask_k = jax.nn.one_hot(idx_k, E, dtype=jnp.int32)     # [S, E]
+            if k == 0:
+                top1_mask = mask_k
+            gate_k = jnp.sum(gates * mask_k, axis=-1)              # [S]
+            # 0-based slot of each token within its chosen expert, counting
+            # tokens assigned by earlier k-rounds first (GShard priority)
+            pos = jnp.cumsum(mask_k, axis=0) - mask_k + offset[None, :]
+            pos_tok = jnp.sum(pos * mask_k, axis=-1)               # [S]
+            keep = (pos_tok < capacity).astype(jnp.int32)
+            disp_k = (
+                (mask_k * keep[:, None])[:, :, None]
+                * jax.nn.one_hot(pos_tok, capacity, dtype=jnp.int32)[:, None]
+            ).astype(jnp.float32)                                  # [S, E, cap]
+            dispatch = dispatch + disp_k
+            combine = combine + disp_k * gate_k[:, None, None]
+            gate_sum = gate_sum + gate_k * keep.astype(jnp.float32)
+            offset = offset + jnp.sum(mask_k * keep[:, None], axis=0)
+            remaining = remaining * (1.0 - mask_k.astype(gates.dtype))
+        if K > 1:
+            # normalize the kept gates to sum to 1 per token (GShard top-2)
+            combine = combine / jnp.maximum(gate_sum, 1e-9)[:, None, None]
+
+        # -- expert computation (batched over E; EP shards axis 0) ---------
+        w_fc = self.param("fc_kernel", _init_normal(0.02), (E, C, hid))
+        w_pr = self.param(
+            "proj_kernel", _init_normal(0.02 / math.sqrt(2 * self.n_layer)),
+            (E, hid, C),
+        )
+        dtype = x.dtype
+        xe = jnp.einsum("sec,sm->ecm", dispatch.astype(dtype), xf)
+        if self.expert_axis:
+            xe = _constrain(xe, (self.expert_axis,))
+        h = jnp.einsum("ecm,emh->ech", xe, w_fc.astype(dtype))
+        if self.bias:
+            b_fc = self.param("fc_bias", nn.initializers.zeros, (E, hid))
+            h = h + b_fc.astype(dtype)[:, None, :]
+        h = nn.gelu(h)
+        ye = jnp.einsum("ech,ehm->ecm", h, w_pr.astype(dtype))
+        if self.bias:
+            b_pr = self.param("proj_bias", nn.initializers.zeros, (E, C))
+            ye = ye + b_pr.astype(dtype)[:, None, :]
+        if self.expert_axis:
+            ye = _constrain(ye, (self.expert_axis,))
+        y = jnp.einsum("sec,ecm->sm", combine.astype(dtype), ye)
+        y = y.reshape(B, T, C)
+        y = nn.Dropout(self.dropout, deterministic=not train)(y)
+
+        # -- auxiliary losses (f32) ----------------------------------------
+        f = jnp.mean(top1_mask.astype(jnp.float32), axis=0)        # [E]
+        p = jnp.mean(gates, axis=0)                                # [E]
+        balance = E * jnp.sum(f * p)
+        z = jnp.mean(jax.scipy.special.logsumexp(logits, axis=-1) ** 2)
+        aux = self.aux_weight * balance + self.z_weight * z
+        return y, aux
+
+
+def moe_param_specs(params: PyTree, base_specs: PyTree = None) -> PyTree:
+    """PartitionSpec tree sharding expert-stacked MoE params over
+    ``'expert'`` (leaves under an ``moe`` module: ``fc_kernel`` [E, C, H],
+    ``proj_kernel`` [E, H, C], ``*_bias`` [E, ·]; the router stays
+    replicated). Non-MoE leaves take ``base_specs``'s spec (e.g. the
+    Megatron TP rules) or replicated ``P()``."""
+    from jax.sharding import PartitionSpec as P
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    if base_specs is None:
+        base = [P()] * len(flat)
+    else:
+        base = jax.tree_util.tree_flatten(
+            base_specs, is_leaf=lambda x: isinstance(x, P)
+        )[0]
+    out = []
+    for (path, leaf), b in zip(flat, base):
+        keys = [str(getattr(k, "key", k)) for k in path]
+        in_moe = any(k == "moe" for k in keys)
+        stacked = keys[-1] in ("fc_kernel", "proj_kernel",
+                               "fc_bias", "proj_bias")
+        if in_moe and stacked:
+            out.append(P(EXPERT_AXIS, *([None] * (leaf.ndim - 1))))
+        else:
+            out.append(b)
+    return jax.tree_util.tree_unflatten(treedef, out)

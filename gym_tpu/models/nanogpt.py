@@ -48,6 +48,21 @@ class GPTConfig:
     # for O(n_layer) less activation memory — the standard TPU lever for
     # fitting GPT-2 base+ shapes (HBM is the bottleneck, MXU has headroom).
     remat: bool = False
+    # Mixture-of-Experts (beyond-reference; SURVEY §2.3 EP row): when
+    # n_experts > 0, every `moe_every`-th block (i % moe_every == moe_every-1,
+    # i.e. alternate blocks at the default 2) replaces its dense MLP with a
+    # top-k routed MoEMLP (models/moe.py). `expert_axis` names a GSPMD-auto
+    # mesh axis to shard experts over (expert parallelism).
+    n_experts: int = 0
+    expert_topk: int = 2
+    capacity_factor: float = 1.25
+    moe_every: int = 2
+    moe_aux_weight: float = 1e-2
+    moe_z_weight: float = 1e-3
+    expert_axis: Optional[str] = None
+
+    def is_moe_layer(self, i: int) -> bool:
+        return self.n_experts > 0 and i % self.moe_every == self.moe_every - 1
 
     @classmethod
     def gpt2_size_map(cls, size: str) -> "GPTConfig":
@@ -156,6 +171,30 @@ class Block(nn.Module):
         return x
 
 
+class MoEBlock(nn.Module):
+    """Pre-norm residual block with a routed MoE MLP: returns ``(x, aux)``
+    where ``aux`` is the layer's weighted auxiliary router loss."""
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cfg = self.config
+        from .moe import MoEMLP
+
+        x = x + CausalSelfAttention(cfg, name="attn")(
+            nn.LayerNorm(epsilon=1e-5, use_bias=cfg.bias, name="ln_1")(x), train
+        )
+        y, aux = MoEMLP(
+            n_embd=cfg.n_embd, n_layer=cfg.n_layer, n_experts=cfg.n_experts,
+            topk=cfg.expert_topk, capacity_factor=cfg.capacity_factor,
+            dropout=cfg.dropout, bias=cfg.bias,
+            aux_weight=cfg.moe_aux_weight, z_weight=cfg.moe_z_weight,
+            expert_axis=cfg.expert_axis, name="moe",
+        )(nn.LayerNorm(epsilon=1e-5, use_bias=cfg.bias, name="ln_2")(x), train)
+        return x + y, aux
+
+
 class GPT(nn.Module):
     """``__call__(batch, train)``: a ``(idx, targets)`` tuple → scalar loss
     (targets == -1 are ignored); a bare ``idx`` array → logits [B, T, V].
@@ -207,8 +246,15 @@ class GPT(nn.Module):
         x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
         block_cls = (nn.remat(Block, static_argnums=(2,)) if cfg.remat
                      else Block)
+        moe_cls = (nn.remat(MoEBlock, static_argnums=(2,)) if cfg.remat
+                   else MoEBlock)
+        aux_total = jnp.zeros((), jnp.float32)
         for i in range(cfg.n_layer):
-            x = block_cls(cfg, name=f"h_{i}")(x, train)
+            if cfg.is_moe_layer(i):
+                x, aux = moe_cls(cfg, name=f"h_{i}")(x, train)
+                aux_total = aux_total + aux
+            else:
+                x = block_cls(cfg, name=f"h_{i}")(x, train)
         x = nn.LayerNorm(epsilon=1e-5, use_bias=cfg.bias, name="ln_f")(x)
         # weight tying: lm_head = wteᵀ (reference :206-208)
         logits = wte.attend(x.astype(wte.embedding.dtype))
@@ -225,7 +271,18 @@ class GPT(nn.Module):
         if cfg.seq_axis is not None:
             loss_sum = jax.lax.psum(loss_sum, cfg.seq_axis)
             count = jax.lax.psum(count, cfg.seq_axis)
-        return loss_sum / jnp.maximum(count, 1.0)
+        loss = loss_sum / jnp.maximum(count, 1.0)
+        if cfg.n_experts > 0 and train:
+            # router auxiliary losses (already weighted per-layer); train
+            # only, so eval loss stays the pure-CE observable the reference
+            # logs (`train_node.py:204-221`). Under context parallelism each
+            # seq shard routes its own token chunk — average the per-shard
+            # aux so the returned scalar stays replicated over `seq_axis`
+            # (the invariant the cp path maintains for the CE terms above).
+            if cfg.seq_axis is not None:
+                aux_total = jax.lax.pmean(aux_total, cfg.seq_axis)
+            loss = loss + aux_total
+        return loss
 
 
 # -- model utilities (reference parity helpers) ----------------------------

@@ -30,6 +30,7 @@ NODE_AXIS = "node"
 VNODE_AXIS = "vnode"
 SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
+EXPERT_AXIS = "expert"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -57,6 +58,11 @@ class AxisCtx:
     # them — the partitioner inserts the collectives.
     tp_axes: tuple = ()
     tp_sizes: tuple = ()
+    # Expert-parallel mesh axes (GSPMD-auto, like tp): MoE expert-stacked
+    # params are sharded over these and XLA inserts the dispatch/combine
+    # all-to-alls (models/moe.py).
+    ep_axes: tuple = ()
+    ep_sizes: tuple = ()
 
     # -- collectives ------------------------------------------------------
 

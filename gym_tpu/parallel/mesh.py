@@ -19,7 +19,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .axis import MODEL_AXIS, NODE_AXIS, SEQ_AXIS, VNODE_AXIS, AxisCtx
+from .axis import (EXPERT_AXIS, MODEL_AXIS, NODE_AXIS, SEQ_AXIS, VNODE_AXIS,
+                   AxisCtx)
 
 PyTree = Any
 
@@ -47,11 +48,12 @@ class NodeRuntime:
     ctx: AxisCtx
     cp: int = 1   # context-parallel group size (devices per 'seq' axis)
     tp: int = 1   # tensor-parallel group size (devices per 'model' axis)
+    ep: int = 1   # expert-parallel group size (devices per 'expert' axis)
 
     @classmethod
     def create(cls, num_nodes: int,
                devices: Sequence[jax.Device] | None = None, cp: int = 1,
-               tp: int = 1):
+               tp: int = 1, ep: int = 1):
         """``cp > 1`` adds a ``'seq'`` mesh axis: each simulated node's
         forward pass is context-parallel over ``cp`` devices (ring attention
         over ICI, SURVEY §5.7 resolution). ``tp > 1`` adds a ``'model'``
@@ -59,14 +61,17 @@ class NodeRuntime:
         ``tp`` devices — the axis stays GSPMD-*auto* (the body is manual
         over ``'node'``/``'seq'`` only) so XLA partitions the matmuls from
         ``with_sharding_constraint`` annotations and inserts the Megatron
-        collectives itself. Mesh is [P, cp?, tp?]; P·cp·tp ≤ devices."""
+        collectives itself. ``ep > 1`` likewise adds a GSPMD-auto
+        ``'expert'`` axis for MoE expert sharding (``models/moe.py``) —
+        XLA inserts the dispatch/combine all-to-alls. Mesh is
+        [P, cp?, tp?, ep?]; P·cp·tp·ep ≤ devices."""
         if devices is None:
             devices = jax.devices()
-        assert len(devices) >= cp * tp, (
-            f"cp={cp}×tp={tp} does not fit {len(devices)} devices"
+        assert len(devices) >= cp * tp * ep, (
+            f"cp={cp}×tp={tp}×ep={ep} does not fit {len(devices)} devices"
         )
         n_phys = _largest_divisor_at_most(num_nodes,
-                                          len(devices) // (cp * tp))
+                                          len(devices) // (cp * tp * ep))
         n_virt = num_nodes // n_phys
         axes = [NODE_AXIS]
         dims = [n_phys]
@@ -76,6 +81,9 @@ class NodeRuntime:
         if tp > 1:
             axes.append(MODEL_AXIS)
             dims.append(tp)
+        if ep > 1:
+            axes.append(EXPERT_AXIS)
+            dims.append(ep)
         grid = np.asarray(devices[: int(np.prod(dims))]).reshape(dims)
         mesh = Mesh(grid, tuple(axes))
         ctx = AxisCtx(
@@ -86,9 +94,11 @@ class NodeRuntime:
             seq_sizes=(cp,) if cp > 1 else (),
             tp_axes=(MODEL_AXIS,) if tp > 1 else (),
             tp_sizes=(tp,) if tp > 1 else (),
+            ep_axes=(EXPERT_AXIS,) if ep > 1 else (),
+            ep_sizes=(ep,) if ep > 1 else (),
         )
         return cls(num_nodes=num_nodes, mesh=mesh, n_phys=n_phys,
-                   n_virt=n_virt, ctx=ctx, cp=cp, tp=tp)
+                   n_virt=n_virt, ctx=ctx, cp=cp, tp=tp, ep=ep)
 
     # -- sharding helpers -------------------------------------------------
 
@@ -127,8 +137,8 @@ class NodeRuntime:
         def block_fn(*args):
             return jax.vmap(node_fn, axis_name=VNODE_AXIS)(*args)
 
-        # manual over node/seq; the 'model' axis (if any) stays GSPMD-auto
-        manual = frozenset(self.mesh.axis_names) - {MODEL_AXIS}
+        # manual over node/seq; 'model'/'expert' axes (if any) stay GSPMD-auto
+        manual = frozenset(self.mesh.axis_names) - {MODEL_AXIS, EXPERT_AXIS}
 
         def program(*args):
             n_in = len(args)

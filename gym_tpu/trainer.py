@@ -106,6 +106,7 @@ class Trainer:
         autocast: bool = False,
         cp: int = 1,
         tp: int = 1,
+        ep: int = 1,
         steps_per_call: int = 1,
         profile_dir: Optional[str] = None,
         checkpoint_interval: Optional[int] = None,
@@ -157,8 +158,21 @@ class Trainer:
                 )
         if cp > 1 and tp > 1:
             raise ValueError("cp and tp cannot be combined yet")
+        if ep > 1:
+            n_exp = getattr(getattr(loss_model.module, "config", None),
+                            "n_experts", 0)
+            ex_ax = getattr(getattr(loss_model.module, "config", None),
+                            "expert_axis", None)
+            from .parallel.axis import EXPERT_AXIS
+            if not n_exp or ex_ax != EXPERT_AXIS:
+                raise ValueError(
+                    f"ep > 1 requires an MoE model with "
+                    f"expert_axis={EXPERT_AXIS!r} (GPTConfig n_experts > 0)"
+                )
+            if n_exp % ep != 0:
+                raise ValueError(f"n_experts={n_exp} not divisible by ep={ep}")
         runtime = NodeRuntime.create(
-            num_nodes, _resolve_devices(device, devices), cp=cp, tp=tp
+            num_nodes, _resolve_devices(device, devices), cp=cp, tp=tp, ep=ep
         )
 
 
@@ -194,6 +208,10 @@ class Trainer:
         # sharded over the 'model' mesh axis via sharding constraints; the
         # specs come from the model family's rules (GPT only for now).
         param_specs = None
+        if tp > 1 or ep > 1:
+            shapes = jax.eval_shape(
+                lambda: loss_model.init(jax.random.PRNGKey(0), example_micro)
+            )
         if tp > 1:
             from .models.nanogpt import GPT as _GPT
             from .parallel.tensor_parallel import gpt_param_specs
@@ -202,10 +220,12 @@ class Trainer:
                     "tp > 1 requires a model with tensor-parallel sharding "
                     "rules (currently: GPT)"
                 )
-            shapes = jax.eval_shape(
-                lambda: loss_model.init(jax.random.PRNGKey(0), example_micro)
-            )
             param_specs = gpt_param_specs(shapes[0])
+        if ep > 1:
+            # expert parallelism: MoE expert-stacked params sharded over the
+            # GSPMD-auto 'expert' axis (composable with the TP specs above)
+            from .models.moe import moe_param_specs
+            param_specs = moe_param_specs(shapes[0], param_specs)
 
         init_fn = make_init_fn(loss_model, strategy, example_micro, seed,
                                param_specs, ctx=runtime.ctx)
@@ -247,7 +267,7 @@ class Trainer:
             "num_params": per_node_params,
             "model_config": _model_config(loss_model.module),
             "mesh": {"physical": runtime.n_phys, "virtual": runtime.n_virt,
-                     "cp": runtime.cp, "tp": runtime.tp},
+                     "cp": runtime.cp, "tp": runtime.tp, "ep": runtime.ep},
             **strategy.config(),
         }
 
