@@ -33,7 +33,9 @@ def measure(size: str = "base", nodes: int = 1, batch: int = 8,
             block: int = 1024, attn: str = "flash", remat: bool = False,
             bf16: bool = True, strategy: str = "diloco", steps: int = 20,
             warmup: int = 3, spc: int = 5,
-            peak_tflops: float = 197.0, shard_outer: bool = False) -> dict:
+            peak_tflops: float = 197.0, shard_outer: bool = False,
+            n_experts: int = 0, expert_topk: int = 2,
+            moe_impl: str = "auto") -> dict:
     """Build the GPT-2 ``size`` model, run ``steps`` training steps with
     ``strategy`` over ``nodes`` simulated nodes and return the measured
     {it/s, MFU, tokens/s, loss, ...} dict. Raises on OOM/compile failure
@@ -55,6 +57,7 @@ def measure(size: str = "base", nodes: int = 1, batch: int = 8,
     cfg = dataclasses.replace(
         GPTConfig.gpt2_size_map(size),
         block_size=block, dropout=0.0, attn_impl=attn, remat=remat,
+        n_experts=n_experts, expert_topk=expert_topk, moe_impl=moe_impl,
     )
     loss_model = LossModel(GPT(cfg), jnp.bfloat16 if bf16 else None)
 
@@ -113,8 +116,12 @@ def measure(size: str = "base", nodes: int = 1, batch: int = 8,
     mfu = node_mfu(cfg, state.params, seqs_per_iter, dt / n_steps,
                    peak_flops=peak_tflops * 1e12)
 
+    result_metric = (f"gpt2_{size}_moe{n_experts}_it_per_sec" if n_experts
+                     else f"gpt2_{size}_it_per_sec")
     return {
-        "metric": f"gpt2_{size}_it_per_sec",
+        "metric": result_metric,
+        **({"n_experts": n_experts, "expert_topk": expert_topk,
+            "moe_impl": moe_impl} if n_experts else {}),
         "value": round(it_s, 3),
         "unit": "it/s",
         "mfu": round(mfu, 4),
@@ -148,6 +155,11 @@ def main() -> None:
     ap.add_argument("--no-bf16", action="store_true")
     ap.add_argument("--strategy", default="diloco",
                     choices=["diloco", "simple", "demo", "zero"])
+    ap.add_argument("--n-experts", type=int, default=0,
+                    help="MoE: experts per MoE block (0 = dense)")
+    ap.add_argument("--expert-topk", type=int, default=2)
+    ap.add_argument("--moe-impl", default="auto",
+                    choices=["auto", "ragged", "einsum"])
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--spc", type=int, default=5,
@@ -168,7 +180,9 @@ def main() -> None:
                      bf16=not args.no_bf16, strategy=args.strategy,
                      steps=args.steps, warmup=args.warmup, spc=args.spc,
                      peak_tflops=args.peak_tflops,
-                     shard_outer=args.shard_outer)
+                     shard_outer=args.shard_outer,
+                     n_experts=args.n_experts, expert_topk=args.expert_topk,
+                     moe_impl=args.moe_impl)
     print(json.dumps(result))
     out_dir = os.path.dirname(args.out)
     if out_dir:

@@ -43,6 +43,30 @@ def _init_normal(std: float):
     return nn.initializers.normal(stddev=std)
 
 
+def _is_batched(x) -> bool:
+    """True when the MoE layer is being traced under ``vmap`` — used to
+    steer the dispatch away from ``lax.ragged_dot``, whose batched form the
+    TPU backend rejects ('number of batch dimensions should be 0') and
+    whose CPU batching rule is partial. Two signals (both needed):
+
+    - the runtime's ``'vnode'`` virtual-node axis is live in the axis env —
+      catches the simulator's vmap even from inside ``lax.scan`` bodies,
+      where values are plain jaxpr tracers, not BatchTracers;
+    - the value itself is a BatchTracer — catches direct user vmaps.
+
+    Private-API imports: pinned by
+    ``tests/test_moe.py::test_moe_auto_impl_under_vmap``."""
+    try:
+        from jax._src.core import get_axis_env
+        from jax._src.interpreters.batching import BatchTracer
+    except ImportError:  # moved upstream: be conservative, use einsum
+        return True
+    from ..parallel.axis import VNODE_AXIS
+    if VNODE_AXIS in get_axis_env().axis_sizes:
+        return True
+    return isinstance(x, BatchTracer)
+
+
 def _constrain(x, spec):
     """``with_sharding_constraint`` that is a no-op under mesh-less tracing
     (unit tests without a mesh context) but fails loudly on a real
@@ -71,6 +95,17 @@ class MoEMLP(nn.Module):
     aux_weight: float = 1e-2
     z_weight: float = 1e-3
     expert_axis: Optional[str] = None  # mesh axis name for EP (GSPMD-auto)
+    # Dispatch implementation:
+    #   'einsum' — GShard one-hot dispatch/combine tensors [S, E, cap].
+    #       Capacity-limited (overflow tokens dropped), EP-shardable, but
+    #       costs O(S·E·cap·C) FLOPs/bytes — at GPT-base shapes that
+    #       *exceeds* the expert matmuls themselves.
+    #   'ragged' — sort tokens by expert, one `jax.lax.ragged_dot` grouped
+    #       matmul per projection (the TPU-native MoE kernel path), combine
+    #       by segment-sum. No capacity limit (no drops), O(S·K·C·H) only.
+    #       Not EP-shardable (row→expert mapping is data-dependent).
+    #   'auto' — ragged when expert_axis is None, einsum under EP.
+    moe_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, train: bool) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -81,11 +116,55 @@ class MoEMLP(nn.Module):
         hid = 4 * C
         xf = x.reshape(S, C)
 
+        impl = self.moe_impl
+        if impl == "auto":
+            impl = ("einsum" if self.expert_axis or _is_batched(x)
+                    else "ragged")
+            if impl == "einsum" and self.capacity_factor * S * K / E < S:
+                import warnings
+                warnings.warn(
+                    "MoE moe_impl='auto' selected the einsum dispatch "
+                    f"(capacity-limited: tokens past capacity_factor="
+                    f"{self.capacity_factor} are dropped), while physical-"
+                    "node runs of the same config use the ragged dispatch "
+                    "(no drops) — the training objective differs with "
+                    "topology. Pin moe_impl='einsum' (or raise "
+                    "capacity_factor to n_experts/topk) for "
+                    "topology-independent semantics.", stacklevel=2,
+                )
+        assert impl in ("einsum", "ragged"), impl
+        assert not (impl == "ragged" and self.expert_axis), (
+            "ragged MoE dispatch cannot shard experts (use moe_impl='einsum' "
+            "for expert parallelism)"
+        )
+
         # -- router (f32) --------------------------------------------------
         logits = nn.Dense(
             E, use_bias=False, kernel_init=_init_normal(0.02), name="router",
         )(xf).astype(jnp.float32)
         gates = jax.nn.softmax(logits, axis=-1)                    # [S, E]
+
+        # -- expert params (shared by both dispatch impls) -----------------
+        w_fc = self.param("fc_kernel", _init_normal(0.02), (E, C, hid))
+        w_pr = self.param(
+            "proj_kernel", _init_normal(0.02 / math.sqrt(2 * self.n_layer)),
+            (E, hid, C),
+        )
+        b_fc = (self.param("fc_bias", nn.initializers.zeros, (E, hid))
+                if self.bias else None)
+        b_pr = (self.param("proj_bias", nn.initializers.zeros, (E, C))
+                if self.bias else None)
+        dtype = x.dtype
+
+        if impl == "ragged":
+            try:
+                return self._ragged(xf, gates, logits, w_fc, b_fc, w_pr,
+                                    b_pr, (B, T, C), train)
+            except NotImplementedError:
+                # lax.ragged_dot has no general batching rule: under a
+                # vmapped node program (virtual nodes, K > devices) fall
+                # back to the one-hot dispatch
+                impl = "einsum"
 
         capacity = min(int(math.ceil(self.capacity_factor * S * K / E)), S)
 
@@ -121,37 +200,85 @@ class MoEMLP(nn.Module):
             combine = combine / jnp.maximum(gate_sum, 1e-9)[:, None, None]
 
         # -- expert computation (batched over E; EP shards axis 0) ---------
-        w_fc = self.param("fc_kernel", _init_normal(0.02), (E, C, hid))
-        w_pr = self.param(
-            "proj_kernel", _init_normal(0.02 / math.sqrt(2 * self.n_layer)),
-            (E, hid, C),
-        )
-        dtype = x.dtype
         xe = jnp.einsum("sec,sm->ecm", dispatch.astype(dtype), xf)
         if self.expert_axis:
             xe = _constrain(xe, (self.expert_axis,))
         h = jnp.einsum("ecm,emh->ech", xe, w_fc.astype(dtype))
-        if self.bias:
-            b_fc = self.param("fc_bias", nn.initializers.zeros, (E, hid))
+        if b_fc is not None:
             h = h + b_fc.astype(dtype)[:, None, :]
         h = nn.gelu(h)
         ye = jnp.einsum("ech,ehm->ecm", h, w_pr.astype(dtype))
-        if self.bias:
-            b_pr = self.param("proj_bias", nn.initializers.zeros, (E, C))
+        if b_pr is not None:
             ye = ye + b_pr.astype(dtype)[:, None, :]
         if self.expert_axis:
             ye = _constrain(ye, (self.expert_axis,))
         y = jnp.einsum("sec,ecm->sm", combine.astype(dtype), ye)
         y = y.reshape(B, T, C)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        return y, self._aux(gates, logits, top1_mask.astype(jnp.float32), E)
 
-        # -- auxiliary losses (f32) ----------------------------------------
-        f = jnp.mean(top1_mask.astype(jnp.float32), axis=0)        # [E]
+    def _ragged(self, xf, gates, logits, w_fc, b_fc, w_pr, b_pr, shape,
+                train: bool) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Sort-based dispatch: tokens grouped by expert, one
+        ``lax.ragged_dot`` per projection, segment-sum combine. No capacity
+        limit — no tokens dropped."""
+        B, T, C = shape
+        E, K = self.n_experts, self.topk
+        S = B * T
+        dtype = xf.dtype
+        topg, topi = jax.lax.top_k(gates, K)                       # [S, K]
+        if K > 1:
+            topg = topg / jnp.maximum(topg.sum(-1, keepdims=True), 1e-9)
+        flat_e = topi.reshape(-1)                                  # [S·K]
+        order = jnp.argsort(flat_e)            # stable: ties keep token order
+        tok = order // K                       # source token per sorted row
+        xs = jnp.take(xf, tok, axis=0)                             # [S·K, C]
+        group_sizes = jnp.bincount(flat_e, length=E).astype(jnp.int32)
+        sorted_e = jnp.take(flat_e, order)
+        h = jax.lax.ragged_dot(xs, w_fc.astype(dtype), group_sizes)
+        if b_fc is not None:
+            h = h + jnp.take(b_fc.astype(dtype), sorted_e, axis=0)
+        h = nn.gelu(h)
+        ye = jax.lax.ragged_dot(h, w_pr.astype(dtype), group_sizes)
+        if b_pr is not None:
+            ye = ye + jnp.take(b_pr.astype(dtype), sorted_e, axis=0)
+        gate_rows = jnp.take(topg.reshape(-1), order).astype(dtype)
+        y = jax.ops.segment_sum(ye * gate_rows[:, None], tok, num_segments=S)
+        y = y.reshape(B, T, C)
+        y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        top1_mask = jax.nn.one_hot(topi[:, 0], E, dtype=jnp.float32)
+        return y, self._aux(gates, logits, top1_mask, E)
+
+    def _aux(self, gates, logits, top1_mask, E) -> jnp.ndarray:
+        """Weighted auxiliary losses (f32): Switch load-balance
+        ``E · Σ_e f_e · p_e`` + router z-loss."""
+        f = jnp.mean(top1_mask, axis=0)                            # [E]
         p = jnp.mean(gates, axis=0)                                # [E]
         balance = E * jnp.sum(f * p)
         z = jnp.mean(jax.scipy.special.logsumexp(logits, axis=-1) ** 2)
-        aux = self.aux_weight * balance + self.z_weight * z
-        return y, aux
+        return self.aux_weight * balance + self.z_weight * z
+
+
+def _is_expert_stacked(path) -> bool:
+    """True for param-tree leaves with a leading [n_experts] axis (the MoE
+    expert weights/biases; the router is not expert-stacked). Single source
+    of truth for ``moe_param_specs`` (what to shard over 'expert') and
+    ``moe_active_params`` (what to scale by topk/E)."""
+    keys = [str(getattr(k, "key", k)) for k in path]
+    return any(k == "moe" for k in keys) and keys[-1] in (
+        "fc_kernel", "proj_kernel", "fc_bias", "proj_bias")
+
+
+def moe_active_params(params: PyTree, topk: int, n_experts: int) -> int:
+    """Parameter count weighted by activation: expert-stacked leaves count
+    at ``topk/n_experts`` of their size (each token runs only its top-k
+    experts), everything else fully. The honest ``N`` for MoE MFU — using
+    the raw total would credit FLOPs that never execute."""
+    total = 0.0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        frac = topk / n_experts if _is_expert_stacked(path) else 1.0
+        total += frac * leaf.size
+    return int(total)
 
 
 def moe_param_specs(params: PyTree, base_specs: PyTree = None) -> PyTree:
@@ -171,11 +298,7 @@ def moe_param_specs(params: PyTree, base_specs: PyTree = None) -> PyTree:
         )[0]
     out = []
     for (path, leaf), b in zip(flat, base):
-        keys = [str(getattr(k, "key", k)) for k in path]
-        in_moe = any(k == "moe" for k in keys)
-        stacked = keys[-1] in ("fc_kernel", "proj_kernel",
-                               "fc_bias", "proj_bias")
-        if in_moe and stacked:
+        if _is_expert_stacked(path):
             out.append(P(EXPERT_AXIS, *([None] * (leaf.ndim - 1))))
         else:
             out.append(b)

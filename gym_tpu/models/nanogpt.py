@@ -60,6 +60,7 @@ class GPTConfig:
     moe_aux_weight: float = 1e-2
     moe_z_weight: float = 1e-3
     expert_axis: Optional[str] = None
+    moe_impl: str = "auto"  # 'ragged' | 'einsum' | 'auto' (see models/moe.py)
 
     def is_moe_layer(self, i: int) -> bool:
         return self.n_experts > 0 and i % self.moe_every == self.moe_every - 1
@@ -190,7 +191,7 @@ class MoEBlock(nn.Module):
             topk=cfg.expert_topk, capacity_factor=cfg.capacity_factor,
             dropout=cfg.dropout, bias=cfg.bias,
             aux_weight=cfg.moe_aux_weight, z_weight=cfg.moe_z_weight,
-            expert_axis=cfg.expert_axis, name="moe",
+            expert_axis=cfg.expert_axis, moe_impl=cfg.moe_impl, name="moe",
         )(nn.LayerNorm(epsilon=1e-5, use_bias=cfg.bias, name="ln_2")(x), train)
         return x + y, aux
 
@@ -322,10 +323,14 @@ def make_adamw(lr, betas=(0.9, 0.95), weight_decay=0.1, params=None):
 
 
 def estimate_mfu(config: GPTConfig, params: Any, fwdbwd_per_iter: float,
-                 dt: float, peak_flops: float = 197e12) -> float:
+                 dt: float, peak_flops: float = 197e12,
+                 n_params: Optional[int] = None) -> float:
     """Model FLOPs utilization. Default peak is TPU v5e bf16 (197 TFLOP/s)
-    rather than the reference's A100 312 TFLOPS (``:394-408``)."""
-    n = num_params(params)
+    rather than the reference's A100 312 TFLOPS (``:394-408``).
+    ``n_params`` overrides the parameter count — used for MoE, where only
+    the routed top-k fraction of expert params does FLOPs per token
+    (``models.moe.moe_active_params``)."""
+    n = n_params if n_params is not None else num_params(params)
     cfg = config
     l, h, q, t = cfg.n_layer, cfg.n_head, cfg.n_embd // cfg.n_head, \
         cfg.block_size
@@ -338,11 +343,19 @@ def node_mfu(config: GPTConfig, node_params: Any, seqs_per_iter: float,
              dt: float, peak_flops: float = 197e12) -> float:
     """MFU from a *node-stacked* param tree (leading [K] axis, as held by
     the runtime/bench/trainer): strips the axis to shapes and delegates to
-    ``estimate_mfu``. Single place for the MFU convention."""
+    ``estimate_mfu``. Single place for the MFU convention. MoE configs
+    count expert params at their routed ``topk/n_experts`` fraction."""
     p0 = jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(x.shape[1:], x.dtype), node_params
     )
-    return estimate_mfu(config, p0, seqs_per_iter, dt, peak_flops=peak_flops)
+    n_active = None
+    if config.n_experts > 0:
+        from .moe import moe_active_params
+        n_active = (moe_active_params(p0, config.expert_topk,
+                                      config.n_experts)
+                    - int(p0["wpe"]["embedding"].size))
+    return estimate_mfu(config, p0, seqs_per_iter, dt,
+                        peak_flops=peak_flops, n_params=n_active)
 
 
 def generate(params: Any, config: GPTConfig, idx: np.ndarray,

@@ -88,8 +88,12 @@ class NodeRuntime:
         mesh = Mesh(grid, tuple(axes))
         ctx = AxisCtx(
             num_nodes=num_nodes,
-            axes=(NODE_AXIS, VNODE_AXIS),
-            sizes=(n_phys, n_virt),
+            # drop the size-1 vmapped axis entirely when every node is
+            # physical: one transform layer less, and primitives without
+            # general batching rules (lax.ragged_dot — the MoE grouped
+            # matmul) stay usable inside the node program
+            axes=(NODE_AXIS, VNODE_AXIS) if n_virt > 1 else (NODE_AXIS,),
+            sizes=(n_phys, n_virt) if n_virt > 1 else (n_phys,),
             seq_axes=(SEQ_AXIS,) if cp > 1 else (),
             seq_sizes=(cp,) if cp > 1 else (),
             tp_axes=(MODEL_AXIS,) if tp > 1 else (),
@@ -134,8 +138,17 @@ class NodeRuntime:
         """
         ctx = self.ctx
 
-        def block_fn(*args):
-            return jax.vmap(node_fn, axis_name=VNODE_AXIS)(*args)
+        if self.n_virt > 1:
+            def block_fn(*args):
+                return jax.vmap(node_fn, axis_name=VNODE_AXIS)(*args)
+        else:
+            # no vmap layer: strip/restore the per-device [V=1] block axis
+            # (asarray: metric leaves may be python scalars, which vmap
+            # would have broadcast)
+            def block_fn(*args):
+                sq = jax.tree.map(lambda x: x[0], args)
+                out = node_fn(*sq)
+                return jax.tree.map(lambda x: jnp.asarray(x)[None], out)
 
         # manual over node/seq; 'model'/'expert' axes (if any) stay GSPMD-auto
         manual = frozenset(self.mesh.axis_names) - {MODEL_AXIS, EXPERT_AXIS}

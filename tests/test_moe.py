@@ -49,16 +49,44 @@ def _naive_moe(params, x, topk, norm):
     return out.reshape(x.shape)
 
 
+@pytest.mark.parametrize("impl", ["einsum", "ragged"])
 @pytest.mark.parametrize("topk", [1, 2])
-def test_moe_matches_naive_routing(topk):
+def test_moe_matches_naive_routing(topk, impl):
     B, T, C, E = 2, 8, 16, 4
     x = jax.random.normal(jax.random.PRNGKey(1), (B, T, C))
     # capacity_factor big enough that no token is ever dropped
     m = MoEMLP(n_embd=C, n_layer=2, n_experts=E, topk=topk,
-               capacity_factor=float(E), dropout=0.0)
+               capacity_factor=float(E), dropout=0.0, moe_impl=impl)
     vs, y, _ = _apply(m, x)
     ref = _naive_moe(vs, x, topk, norm=topk > 1)
     np.testing.assert_allclose(y, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_moe_ragged_equals_einsum_with_grads():
+    """The two dispatch impls are the same math when nothing is dropped —
+    outputs AND parameter gradients agree."""
+    B, T, C, E = 2, 8, 16, 4
+    x = jax.random.normal(jax.random.PRNGKey(5), (B, T, C))
+
+    def run(impl):
+        m = MoEMLP(n_embd=C, n_layer=2, n_experts=E, topk=2,
+                   capacity_factor=float(E), dropout=0.0, moe_impl=impl)
+        vs = m.init({"params": jax.random.PRNGKey(7)}, x, train=False)
+
+        def loss(p):
+            y, aux = m.apply({"params": p}, x, train=False)
+            return (y ** 2).mean() + aux
+
+        val, grads = jax.value_and_grad(loss)(vs["params"])
+        return float(val), grads
+
+    v_e, g_e = run("einsum")
+    v_r, g_r = run("ragged")
+    assert abs(v_e - v_r) < 1e-5
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5),
+        g_e, g_r,
+    )
 
 
 def test_moe_capacity_drops_tokens():
@@ -67,11 +95,32 @@ def test_moe_capacity_drops_tokens():
     them), and no expert slot is used twice."""
     B, T, C, E = 1, 16, 8, 2
     x = jax.random.normal(jax.random.PRNGKey(2), (B, T, C))
-    m = MoEMLP(n_embd=C, n_layer=2, n_experts=E, topk=1,
+    m = MoEMLP(n_embd=C, n_layer=2, n_experts=E, topk=1, moe_impl="einsum",
                capacity_factor=E * 1.0 / (B * T), dropout=0.0)  # cap = 1
     _, y, _ = _apply(m, x)
     nz_rows = np.any(np.abs(y.reshape(-1, C)) > 0, axis=-1).sum()
     assert nz_rows <= E  # at most one token per expert survived
+
+
+def test_moe_auto_impl_under_vmap():
+    """'auto' resolves to the einsum dispatch under vmap (virtual nodes):
+    the batched ragged_dot form doesn't lower. Also pins the private
+    imports used for the detection."""
+    from jax._src.core import get_axis_env
+    from jax._src.interpreters.batching import BatchTracer  # noqa: F401
+    assert hasattr(get_axis_env(), "axis_sizes")
+
+    B, T, C, E = 2, 8, 16, 4
+    x = jax.random.normal(jax.random.PRNGKey(4), (3, B, T, C))
+    m = MoEMLP(n_embd=C, n_layer=2, n_experts=E, topk=2,
+               capacity_factor=4.0, dropout=0.0, moe_impl="auto")
+    vs = m.init({"params": jax.random.PRNGKey(0)}, x[0], train=False)
+
+    y, aux = jax.vmap(lambda xi: m.apply(vs, xi, train=False))(x)
+    y0, _ = m.apply(vs, x[0], train=False)  # unbatched → ragged path
+    assert y.shape == x.shape
+    np.testing.assert_allclose(np.asarray(y[0]), np.asarray(y0),
+                               rtol=2e-4, atol=2e-5)
 
 
 def test_moe_aux_loss_balanced_router():
