@@ -65,6 +65,81 @@ def initialize(
     return True
 
 
+def global_batch(runtime, local_tree, process_index: Optional[int] = None):
+    """Assemble a *global* node-sharded batch from process-local data.
+
+    Single-process ``runtime.shard_batch`` ships the whole [K, ...] batch;
+    in a multi-process world each host holds only its own nodes' slice.
+    ``local_tree`` leaves are [K_local, ...] (this process's nodes, in mesh
+    order); the returned global arrays have leading axis K with every
+    process contributing exactly its addressable shards — no host ever
+    materializes another host's data (the property that makes per-host
+    data loading scale, reference ``DistributedSampler`` semantics at host
+    granularity)."""
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    sharding: NamedSharding = runtime.node_sharding
+    mesh_arr = runtime.mesh.devices
+    mesh_devs = list(mesh_arr.flat)
+    if process_index is None:
+        # the process index of the MESH's backend — jax.process_index()
+        # reads the default backend, which can be a different platform
+        # (e.g. a single-process TPU plugin alongside a multi-process CPU
+        # world) and then reports 0 in every process
+        process_index = mesh_devs[0].client.process_index()
+    local_devs = [d for d in mesh_devs if d.process_index == process_index]
+    assert local_devs, f"process {process_index} owns no mesh devices"
+
+    # A batch is sharded over the 'node' (first) mesh axis only and
+    # REPLICATED over any cp/tp/ep axes — devices sharing a node-axis
+    # coordinate hold the same rows. Map each local device to its node
+    # coordinate; local_tree rows are ordered by this process's node
+    # coordinates.
+    coord = {d: int(np.argwhere(mesh_arr == d)[0][0]) for d in local_devs}
+    local_coords = sorted(set(coord.values()))
+    row_of = {c: i for i, c in enumerate(local_coords)}
+
+    def build(x):
+        x = np.asarray(x)
+        assert x.shape[0] % len(local_coords) == 0, (
+            f"local leading axis {x.shape[0]} not divisible by this "
+            f"process's {len(local_coords)} node-axis shards"
+        )
+        per = x.shape[0] // len(local_coords)
+        k_global = per * runtime.n_phys
+        shards = [
+            jax.device_put(
+                x[row_of[coord[d]] * per:(row_of[coord[d]] + 1) * per], d
+            )
+            for d in local_devs
+        ]
+        return jax.make_array_from_single_device_arrays(
+            (k_global,) + x.shape[1:], sharding, shards
+        )
+
+    return jax.tree.map(build, local_tree)
+
+
+def local_values(tree):
+    """Host copy of the *addressable* shards of a globally-sharded pytree,
+    concatenated along the leading axis (this process's nodes only) — the
+    multi-host-safe replacement for ``jax.device_get`` on global arrays."""
+    import numpy as np
+
+    def fetch(x):
+        # one shard per distinct index: on a multi-axis mesh the node rows
+        # are replicated across cp/tp/ep devices — keep a single copy
+        uniq = {}
+        for s in x.addressable_shards:
+            key = (s.index[0].start or 0) if s.index else 0
+            uniq.setdefault(key, s)
+        shards = [uniq[k] for k in sorted(uniq)]
+        return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+
+    return jax.tree.map(fetch, tree)
+
+
 def is_primary() -> bool:
     """True on the host that should own logging/checkpoint writes
     (the analog of the reference's rank-0-only logger gate,
