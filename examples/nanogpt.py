@@ -65,17 +65,20 @@ def create_strategy(args):
         return ZeroReduceStrategy(optim_spec=optim, **sched)
     if args.strategy == "fedavg":
         return FedAvgStrategy(inner_optim=optim, H=args.H,
-                              island_size=args.island_size, **sched)
+                              island_size=args.island_size,
+                              participation=args.participation, **sched)
     if args.strategy == "diloco":
         return DiLoCoStrategy(
             optim_spec=optim,
             outer_optim_spec=OptimSpec(
                 "sgd", lr=args.outer_lr, nesterov=args.nesterov,
                 momentum=args.outer_momentum),
-            H=args.diloco_interval, **sched)
+            H=args.diloco_interval,
+            participation=args.participation, **sched)
     if args.strategy == "sparta":
         return SPARTAStrategy(inner_optim=optim, p_sparta=args.p_sparta,
-                              interval=args.sparta_interval, **sched)
+                              interval=args.sparta_interval,
+                              participation=args.participation, **sched)
     if args.strategy == "diloco_sparta":
         return SPARTADiLoCoStrategy(
             optim_spec=optim,
@@ -83,7 +86,8 @@ def create_strategy(args):
                 "sgd", lr=args.outer_lr, nesterov=args.nesterov,
                 momentum=args.outer_momentum),
             p_sparta=args.p_sparta, H=args.diloco_interval,
-            sparta_interval=args.sparta_interval, **sched)
+            sparta_interval=args.sparta_interval,
+            participation=args.participation, **sched)
     if args.strategy == "demo":
         return DeMoStrategy(
             optim_spec=OptimSpec("sgd", lr=args.lr),
@@ -153,6 +157,11 @@ def main():
                    help="every Nth block is MoE (2 = alternate)")
     p.add_argument("--ep", type=int, default=1,
                    help="expert-parallel devices (shards experts)")
+    p.add_argument("--participation", type=float, default=1.0,
+                   help="fraction of nodes alive per comm round "
+                        "(simulated failures; fedavg/diloco/sparta)")
+    p.add_argument("--skip_nonfinite", action="store_true",
+                   help="quarantine non-finite per-node gradients")
     args = p.parse_args()
 
     attn = args.attn_impl or ("ring" if args.cp > 1 else "dense")
@@ -203,6 +212,7 @@ def main():
         minibatch_size=args.minibatch_size,
         cp=args.cp,
         ep=args.ep,
+        skip_nonfinite=args.skip_nonfinite,
         autocast=args.autocast,
         seed=args.seed,
         val_size=args.val_size,

@@ -129,9 +129,19 @@ def local_values(tree):
 
     def fetch(x):
         # one shard per distinct index: on a multi-axis mesh the node rows
-        # are replicated across cp/tp/ep devices — keep a single copy
+        # are replicated across cp/tp/ep devices — keep a single copy.
+        # Only leading-axis sharding is supported (node-sharded batches and
+        # metrics); a leaf split along a trailing axis (tp/ep params) would
+        # silently truncate, so fail loudly instead.
         uniq = {}
         for s in x.addressable_shards:
+            if s.data.shape[1:] != x.shape[1:]:
+                raise ValueError(
+                    "local_values supports leading-axis (node) sharding "
+                    f"only; got shard shape {s.data.shape} of global "
+                    f"{x.shape} (trailing axes split — a tp/ep-sharded "
+                    "leaf?)"
+                )
             key = (s.index[0].start or 0) if s.index else 0
             uniq.setdefault(key, s)
         shards = [uniq[k] for k in sorted(uniq)]

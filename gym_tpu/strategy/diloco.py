@@ -51,9 +51,14 @@ class DiLoCoCommunicator(CommunicationModule):
         H: int = 100,
         outer_optim_spec: Optional[Union[str, OptimSpec]] = None,
         shard_outer: bool = False,
+        participation: float = 1.0,
+        fault_seed: int = 5678,
     ):
+        assert 0.0 < participation <= 1.0, participation
         self.H = int(H)
         self.shard_outer = bool(shard_outer)
+        self.participation = float(participation)
+        self.fault_seed = fault_seed
         self.outer_optim_spec = ensure_optim_spec(
             outer_optim_spec,
             OptimSpec("sgd", lr=0.7, nesterov=True, momentum=0.9),
@@ -83,8 +88,26 @@ class DiLoCoCommunicator(CommunicationModule):
         k = ctx.num_nodes
         psize = float(tree_bytes(params))
 
+        def _avg_and_alive(params):
+            """Round average + this node's participation flag. With
+            participation < 1 (simulated failures, ``strategy/faults.py``)
+            only alive nodes' params enter the outer pseudo-gradient; the
+            outer master/momentum update stays replicated-identical on
+            EVERY node (the alive mask is shared-PRNG), so dead nodes'
+            outer state cannot drift — they just skip the param sync and
+            rejoin with stale local params."""
+            if self.participation >= 1.0:
+                return (ctx.pmean(params), jnp.asarray(True),
+                        jnp.asarray(float(k)))
+            from .faults import alive_mask, masked_mean
+            alive = alive_mask(self.fault_seed, step, k, self.participation)
+            me_alive = alive[ctx.node_index()]
+            group = jnp.sum(alive.astype(jnp.float32))
+            return (masked_mean(params, me_alive.astype(jnp.float32), ctx),
+                    me_alive, group)
+
         def outer_replicated(params, mstate):
-            avg = ctx.pmean(params)
+            avg, me_alive, group = _avg_and_alive(params)
             master = mstate["master"]
             # outer pseudo-gradient: master − averaged (reference :43-45)
             pseudo = jax.tree.map(jnp.subtract, master, avg)
@@ -93,20 +116,34 @@ class DiLoCoCommunicator(CommunicationModule):
             )
             master = optax.apply_updates(master, updates)
             # all nodes sync to the new master (reference :47-49, :73-74 —
-            # but without the broadcast: the computation is replicated)
-            comm = jnp.asarray(2.0 * (k - 1) / max(k, 1) * psize)
-            return master, {"master": master, "outer_opt": outer_opt}, comm
+            # but without the broadcast: the computation is replicated);
+            # a dead node misses the sync and keeps its local params
+            new_params = jax.tree.map(
+                lambda m, p: jnp.where(me_alive, m, p), master, params
+            )
+            comm = (me_alive * 2.0 * (group - 1)
+                    / jnp.maximum(group, 1) * psize)
+            return (new_params,
+                    {"master": master, "outer_opt": outer_opt}, comm)
 
         def outer_sharded(params, mstate):
-            avg = ctx.pmean(params)
+            avg, me_alive, group = _avg_and_alive(params)
             avg_my, unravel, n = take_shard(avg, k, ctx.node_index())
             pseudo = mstate["master"] - avg_my
             updates, outer_opt = self.outer_tx.update(
                 pseudo, mstate["outer_opt"], mstate["master"]
             )
             master = optax.apply_updates(mstate["master"], updates)
-            new_params = unshard(ctx, master, n, unravel)
-            comm = jnp.asarray(3.0 * (k - 1) / max(k, 1) * psize)
+            # every node's shard is valid regardless of aliveness (the
+            # sharded outer state is slices of a replicated-identical
+            # master), so the all_gather reassembly is fault-agnostic;
+            # only the final param sync respects the alive mask
+            assembled = unshard(ctx, master, n, unravel)
+            new_params = jax.tree.map(
+                lambda m, p: jnp.where(me_alive, m, p), assembled, params
+            )
+            comm = (me_alive * 3.0 * (group - 1)
+                    / jnp.maximum(group, 1) * psize)
             return (new_params,
                     {"master": master, "outer_opt": outer_opt}, comm)
 
@@ -123,6 +160,8 @@ class DiLoCoCommunicator(CommunicationModule):
                "outer_lr": self.outer_optim_spec.lr}
         if self.shard_outer:
             cfg["shard_outer"] = True
+        if self.participation < 1.0:
+            cfg["participation"] = self.participation
         return cfg
 
 
@@ -140,12 +179,14 @@ class DiLoCoStrategy(CommunicateOptimizeStrategy):
         lr_scheduler=None,
         lr_scheduler_kwargs=None,
         shard_outer: bool = False,
+        participation: float = 1.0,
     ):
         self.H = int(H)
         super().__init__(
             communication_modules=[
                 DiLoCoCommunicator(H=H, outer_optim_spec=outer_optim_spec,
-                                   shard_outer=shard_outer)
+                                   shard_outer=shard_outer,
+                                   participation=participation)
             ],
             inner_optim=ensure_optim_spec(optim_spec, OptimSpec("adamw")),
             max_norm=max_norm,

@@ -1,0 +1,57 @@
+"""Simulated node failures: partial participation + gradient quarantine.
+
+Beyond-reference (SURVEY §5.3: the reference has NO failure handling — a
+crashed rank kills the whole ``mp.spawn`` world, ``exogym/trainer.py:227``;
+§2.3's elastic-membership row is ❌). In a *simulator* of distributed
+training methods, the research-relevant form of elasticity is **partial
+participation**: every communication round, a deterministic subset of nodes
+"fails" (straggler / dropout semantics from the federated-learning
+literature). SPMD-native restatement:
+
+- the alive set is drawn from a *shared* PRNG (same key on every node —
+  agreement without communication, the same trick as SPARTA's masks);
+- collectives always execute (SPMD programs are lockstep by construction);
+  failure is expressed through *weights*: a masked mean
+  ``psum(alive·x) / psum(alive)`` excludes dead nodes' contributions;
+- a dead node keeps its local params for the round and rejoins later with
+  stale state — exactly the observable the local/global eval protocol
+  (reference ``train_node.py:181-246``) was built to study.
+
+``SimpleReduceStrategy(quarantine_nonfinite=True)``-style gradient
+containment lives in ``train_node.make_train_step(skip_nonfinite=...)``:
+a node whose loss/grads go non-finite contributes zero gradient that step
+(detection + containment; recovery = checkpoint/resume, SURVEY §5.4).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+def alive_mask(seed: int, round_index, k: int, rate: float) -> jnp.ndarray:
+    """[k] bool, identical on every node: node i participates in this
+    communication round iff ``u_i < rate`` (shared-PRNG Bernoulli), with
+    the smallest-``u`` node forced alive so a round always has at least
+    one participant (only changes the all-dead draw)."""
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), round_index)
+    u = jax.random.uniform(key, (k,))
+    alive = u < rate
+    return alive.at[jnp.argmin(u)].set(True)
+
+
+def masked_mean(tree: PyTree, weight, ctx) -> PyTree:
+    """Mean over the node axis counting only nodes with ``weight`` 1
+    (this node's scalar weight; dead nodes contribute zero). The SPMD form
+    of 'average among the alive subset' — the collective always runs,
+    membership is arithmetic."""
+    w = jnp.asarray(weight, jnp.float32)
+    denom = ctx.psum(w)
+    num = jax.tree.map(lambda x: ctx.psum(x.astype(jnp.float32) * w), tree)
+    return jax.tree.map(
+        lambda n, x: (n / denom).astype(x.dtype), num, tree
+    )

@@ -28,21 +28,51 @@ from .optim import OptimSpec
 
 class AveragingCommunicator(CommunicationModule):
     """Full or island-subset parameter averaging
-    (reference ``federated_averaging.py:16-82``)."""
+    (reference ``federated_averaging.py:16-82``).
 
-    def __init__(self, island_size: Optional[int] = None, seed: int = 1234):
+    ``participation < 1`` simulates node failures (beyond-reference,
+    SURVEY §5.3 / §2.3 elastic row): each round a shared-PRNG subset of
+    nodes is "down" — they neither contribute to nor receive the average,
+    keeping their local params until they rejoin (federated partial
+    participation). See ``strategy/faults.py``."""
+
+    def __init__(self, island_size: Optional[int] = None, seed: int = 1234,
+                 participation: float = 1.0, fault_seed: int = 5678):
+        assert 0.0 < participation <= 1.0, participation
         self.island_size = island_size
         self.seed = seed
+        self.participation = float(participation)
+        self.fault_seed = fault_seed
 
     def communicate(self, params, mstate, step, ctx):
+        from .faults import alive_mask, masked_mean
+
         k = ctx.num_nodes
         if k == 1:
             return params, mstate, jnp.zeros(())
         psize = float(tree_bytes(params))
         isl = self.island_size if self.island_size is not None else k
+        me = ctx.node_index()
+
+        if self.participation < 1.0:
+            alive = alive_mask(self.fault_seed, step, k, self.participation)
+            me_alive = alive[me]
+        else:
+            alive = jnp.ones((k,), bool)
+            me_alive = jnp.asarray(True)
 
         if isl >= k:
-            # full averaging — the reference's fast path (:56-59)
+            # full averaging — the reference's fast path (:56-59), over
+            # the alive subset; dead nodes keep their local params
+            if self.participation < 1.0:
+                w = me_alive.astype(jnp.float32)
+                avg = masked_mean(params, w, ctx)
+                new = jax.tree.map(
+                    lambda a, p: jnp.where(me_alive, a, p), avg, params
+                )
+                a = jnp.sum(alive.astype(jnp.float32))
+                comm = me_alive * 2.0 * (a - 1) / jnp.maximum(a, 1) * psize
+                return new, mstate, comm
             avg = ctx.pmean(params)
             comm = jnp.asarray(2.0 * (k - 1) / k * psize)
             return avg, mstate, comm
@@ -53,9 +83,8 @@ class AveragingCommunicator(CommunicationModule):
         perm = jax.random.permutation(key, k)     # same on every node
         pos = jnp.argsort(perm)                   # pos[r] = slot of rank r
         island_of = pos // isl                    # [k] island id per rank
-        me = ctx.node_index()
-        member = (island_of == island_of[me])     # [k] bool
-        denom = jnp.sum(member)
+        member = (island_of == island_of[me]) & alive  # [k] bool
+        denom = jnp.maximum(jnp.sum(member), 1)
 
         gathered = ctx.all_gather(params)         # leaves [k, ...]
 
@@ -64,12 +93,19 @@ class AveragingCommunicator(CommunicationModule):
             return jnp.sum(g * w, axis=0) / denom.astype(g.dtype)
 
         avg = jax.tree.map(island_mean, gathered)
+        if self.participation < 1.0:
+            avg = jax.tree.map(
+                lambda a, p: jnp.where(me_alive, a, p), avg, params
+            )
         # all_gather: each node transmits its full model once (:61-69)
-        return avg, mstate, jnp.asarray(psize)
+        return avg, mstate, me_alive * psize
 
     def config(self):
-        return {"module": "AveragingCommunicator",
-                "island_size": self.island_size}
+        cfg = {"module": "AveragingCommunicator",
+               "island_size": self.island_size}
+        if self.participation < 1.0:
+            cfg["participation"] = self.participation
+        return cfg
 
 
 class FedAvgStrategy(CommunicateOptimizeStrategy):
@@ -84,9 +120,13 @@ class FedAvgStrategy(CommunicateOptimizeStrategy):
         max_norm: Optional[float] = None,
         lr_scheduler=None,
         lr_scheduler_kwargs=None,
+        participation: float = 1.0,
     ):
         super().__init__(
-            communication_modules=[AveragingCommunicator(island_size)],
+            communication_modules=[
+                AveragingCommunicator(island_size,
+                                      participation=participation)
+            ],
             inner_optim=inner_optim,
             max_norm=max_norm,
             lr_scheduler=lr_scheduler,

@@ -98,14 +98,22 @@ class PartitionedIndexSelector(IndexSelector):
 
 
 class SparseCommunicator(CommunicationModule):
-    """Masked parameter averaging (reference ``sparta.py:14-47``)."""
+    """Masked parameter averaging (reference ``sparta.py:14-47``).
 
-    def __init__(self, index_selector: IndexSelector, interval: int = 1):
+    ``participation < 1`` simulates per-round node failures (shared-PRNG
+    alive subset, ``strategy/faults.py``): dead nodes neither contribute
+    to nor receive the sparse exchange that round."""
+
+    def __init__(self, index_selector: IndexSelector, interval: int = 1,
+                 participation: float = 1.0, fault_seed: int = 5678):
+        assert 0.0 < participation <= 1.0, participation
         self.index_selector = index_selector
         # `interval` generalizes the reference's (parsed-but-unused)
         # --sparta_interval flag (SURVEY §5.6): exchange every `interval`
         # steps instead of every step.
         self.interval = int(interval)
+        self.participation = float(participation)
+        self.fault_seed = fault_seed
 
     def communicate(self, params, mstate, step, ctx):
         if ctx.num_nodes == 1:
@@ -116,17 +124,28 @@ class SparseCommunicator(CommunicationModule):
             # call; with interval=1 iteration == step.
             iteration = step // self.interval
             masks = self.index_selector.masks(params, iteration)
-            avg = ctx.pmean(params)
+            k = ctx.num_nodes
+            if self.participation < 1.0:
+                from .faults import alive_mask, masked_mean
+                alive = alive_mask(self.fault_seed, step, ctx.num_nodes,
+                                   self.participation)
+                me_alive = alive[ctx.node_index()]
+                avg = masked_mean(params, me_alive.astype(jnp.float32), ctx)
+                masks = jax.tree.map(lambda m: m & me_alive, masks)
+                group = jnp.sum(alive.astype(jnp.float32))  # ring is alive-only
+            else:
+                avg = ctx.pmean(params)
+                group = jnp.asarray(float(k))
             new_params = jax.tree.map(
                 lambda m, a, p: jnp.where(m, a, p), masks, avg, params
             )
-            k = ctx.num_nodes
+            # masks are zeroed for dead nodes, so nbytes is already 0 there
             nbytes = sum(
                 jnp.sum(m) * jnp.asarray(p.dtype.itemsize, jnp.float32)
                 for m, p in zip(jax.tree.leaves(masks),
                                 jax.tree.leaves(params))
             )
-            comm = 2.0 * (k - 1) / k * nbytes
+            comm = 2.0 * (group - 1) / jnp.maximum(group, 1) * nbytes
             return new_params, mstate, comm
 
         def skip(params, mstate):
@@ -138,10 +157,13 @@ class SparseCommunicator(CommunicationModule):
                             params, mstate)
 
     def config(self):
-        return {"module": "SparseCommunicator",
-                "p_sparta": self.index_selector.p,
-                "selector": type(self.index_selector).__name__,
-                "interval": self.interval}
+        cfg = {"module": "SparseCommunicator",
+               "p_sparta": self.index_selector.p,
+               "selector": type(self.index_selector).__name__,
+               "interval": self.interval}
+        if self.participation < 1.0:
+            cfg["participation"] = self.participation
+        return cfg
 
 
 class SPARTAStrategy(CommunicateOptimizeStrategy):
@@ -157,10 +179,14 @@ class SPARTAStrategy(CommunicateOptimizeStrategy):
         max_norm: Optional[float] = None,
         lr_scheduler=None,
         lr_scheduler_kwargs=None,
+        participation: float = 1.0,
     ):
         selector = index_selector or RandomIndexSelector(p_sparta)
         super().__init__(
-            communication_modules=[SparseCommunicator(selector, interval)],
+            communication_modules=[
+                SparseCommunicator(selector, interval,
+                                   participation=participation)
+            ],
             inner_optim=inner_optim,
             max_norm=max_norm,
             lr_scheduler=lr_scheduler,

@@ -31,12 +31,17 @@ class SPARTADiLoCoStrategy(CommunicateOptimizeStrategy):
         max_norm: Optional[float] = None,
         lr_scheduler=None,
         lr_scheduler_kwargs=None,
+        participation: float = 1.0,
     ):
         selector = index_selector or RandomIndexSelector(p_sparta)
         super().__init__(
             communication_modules=[
-                SparseCommunicator(selector, interval=sparta_interval),
-                DiLoCoCommunicator(H=H, outer_optim_spec=outer_optim_spec),
+                # both rounds share one fault draw per step (same seed):
+                # a node down for the gossip is down for the outer loop too
+                SparseCommunicator(selector, interval=sparta_interval,
+                                   participation=participation),
+                DiLoCoCommunicator(H=H, outer_optim_spec=outer_optim_spec,
+                                   participation=participation),
             ],
             inner_optim=ensure_optim_spec(optim_spec, OptimSpec("adamw")),
             max_norm=max_norm,

@@ -77,7 +77,7 @@ def make_init_fn(loss_model: LossModel, strategy: Strategy, example_micro,
 
 
 def make_train_step(loss_model: LossModel, strategy: Strategy, ctx: AxisCtx,
-                    param_specs=None):
+                    param_specs=None, skip_nonfinite: bool = False):
     """Build ``node_step(state, batch) -> (state, metrics)``.
 
     ``batch`` leaves are [n_micro, micro_bs, ...]; the scan accumulates
@@ -87,6 +87,13 @@ def make_train_step(loss_model: LossModel, strategy: Strategy, ctx: AxisCtx,
     ``param_specs``: tensor-parallel sharding constraints (see
     ``constrain_params``); applied to params at step entry and exit so the
     whole state (grads, opt state) inherits the Megatron layout.
+
+    ``skip_nonfinite``: failure detection + containment (beyond-reference,
+    SURVEY §5.3 — the reference has none): a node whose loss or gradients
+    go non-finite this step contributes ZERO gradient instead, so one
+    diverged replica cannot poison the collective mean; the event is
+    surfaced as ``metrics['nonfinite']`` (per-node 0/1) for the logger.
+    Recovery is checkpoint/resume (SURVEY §5.4).
     """
 
     def node_step(state: TrainState, batch) -> Tuple[TrainState, Dict]:
@@ -122,6 +129,17 @@ def make_train_step(loss_model: LossModel, strategy: Strategy, ctx: AxisCtx,
         grads = jax.tree.map(lambda g: g / n_micro, gsum)
         loss = lsum / n_micro
 
+        if skip_nonfinite:
+            ok = jnp.isfinite(loss)
+            for g in jax.tree.leaves(grads):
+                ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g)))
+            # quarantine: zero the whole gradient (select, not multiply —
+            # NaN·0 is NaN) so this node's divergence can't poison the
+            # collective mean in strategy.step
+            grads = jax.tree.map(
+                lambda g: jnp.where(ok, g, jnp.zeros_like(g)), grads
+            )
+
         params, sstate, metrics = strategy.step(
             grads, state.params, state.strategy_state, state.step, ctx
         )
@@ -134,13 +152,16 @@ def make_train_step(loss_model: LossModel, strategy: Strategy, ctx: AxisCtx,
         )
         metrics = dict(metrics)
         metrics["loss"] = loss
+        if skip_nonfinite:
+            metrics["nonfinite"] = 1.0 - ok.astype(jnp.float32)
         return new_state, metrics
 
     return node_step
 
 
 def make_multi_train_step(loss_model: LossModel, strategy: Strategy,
-                          ctx: AxisCtx, param_specs=None):
+                          ctx: AxisCtx, param_specs=None,
+                          skip_nonfinite: bool = False):
     """S training steps per dispatch: ``node_multi(state, batches)`` where
     batch leaves are [S, n_micro, micro_bs, ...]; returns metrics with a
     leading [S] axis.
@@ -152,7 +173,8 @@ def make_multi_train_step(loss_model: LossModel, strategy: Strategy,
     per-step strategy schedule (H gates, step counter) advances inside the
     scan.
     """
-    node_step = make_train_step(loss_model, strategy, ctx, param_specs)
+    node_step = make_train_step(loss_model, strategy, ctx, param_specs,
+                                skip_nonfinite)
 
     def node_multi(state: TrainState, batches):
         return jax.lax.scan(node_step, state, batches)

@@ -107,6 +107,7 @@ class Trainer:
         cp: int = 1,
         tp: int = 1,
         ep: int = 1,
+        skip_nonfinite: bool = False,
         steps_per_call: int = 1,
         profile_dir: Optional[str] = None,
         checkpoint_interval: Optional[int] = None,
@@ -243,13 +244,14 @@ class Trainer:
                 train_iter.load_state(data_state)
 
         train_step = runtime.compile(
-            make_train_step(loss_model, strategy, runtime.ctx, param_specs)
+            make_train_step(loss_model, strategy, runtime.ctx, param_specs,
+                            skip_nonfinite)
         )
         multi_step = None
         if steps_per_call > 1:
             multi_step = runtime.compile(
                 make_multi_train_step(loss_model, strategy, runtime.ctx,
-                                      param_specs)
+                                      param_specs, skip_nonfinite)
             )
         eval_step = runtime.compile(
             make_eval_step(loss_model, runtime.ctx), donate_state=False
@@ -282,7 +284,7 @@ class Trainer:
 
         history: Dict[str, List] = {
             "train_loss": [], "local_loss": [], "global_loss": [],
-            "comm_bytes": [], "comm_recv_bytes": [],
+            "comm_bytes": [], "comm_recv_bytes": [], "nonfinite": [],
         }
 
         def run_eval():
@@ -321,6 +323,10 @@ class Trainer:
             comm_a = np.asarray(m["comm_bytes"])[0].reshape(count)
             recv_a = (np.asarray(m["comm_recv_bytes"])[0].reshape(count)
                       if "comm_recv_bytes" in m else None)
+            # quarantine events: sum over the node axis (how many replicas
+            # went non-finite this step)
+            nf_a = (np.asarray(m["nonfinite"]).sum(axis=0).reshape(count)
+                    if "nonfinite" in m else None)
             for j in range(count):
                 step_j = first_idx + j
                 loss = float(loss_a[j])
@@ -332,6 +338,12 @@ class Trainer:
                 if recv_a is not None:
                     history["comm_recv_bytes"].append(
                         (step_j, float(recv_a[j]))
+                    )
+                if nf_a is not None and nf_a[j] > 0:
+                    history["nonfinite"].append((step_j, float(nf_a[j])))
+                    logger.log_event(
+                        f"quarantined {int(nf_a[j])} node(s) with "
+                        f"non-finite gradients"
                     )
 
         # Profiling (SURVEY §5.1 — absent in the reference): capture an

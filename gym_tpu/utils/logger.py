@@ -53,6 +53,11 @@ class Logger:
                 f"(ppl {math.exp(min(loss, 20.0)):.2f})"
             )
 
+    def log_event(self, msg: str) -> None:
+        """One-off notable event (e.g. non-finite quarantine)."""
+        if self.pbar is not None:
+            self.pbar.write(f"step {self.step}: {msg}")
+
     def increment_step(self) -> None:
         self.step += 1
         if self.pbar is not None:

@@ -1,0 +1,215 @@
+"""Simulated node failures (strategy/faults.py) + non-finite quarantine.
+
+Beyond-reference capability (SURVEY §5.3: the reference has no failure
+handling at all — a crashed rank kills the mp.spawn world). Semantics
+pinned here:
+- partial participation: dead nodes neither contribute to nor receive a
+  communication round; participation=1 is bit-identical to the baseline;
+- alive masks are shared-PRNG (agreement without communication) with at
+  least one participant per round;
+- a node whose loss/grads go non-finite contributes zero gradient and
+  cannot poison the collective mean (fit(skip_nonfinite=True)).
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from gym_tpu.strategy import (DiLoCoStrategy, FedAvgStrategy, OptimSpec,
+                              SPARTAStrategy)
+from gym_tpu.strategy.faults import alive_mask, masked_mean
+
+from test_strategies import make_harness
+
+
+def test_alive_mask_shared_and_nonempty():
+    for step in range(20):
+        m = np.asarray(alive_mask(0, step, 8, 0.3))
+        assert m.sum() >= 1
+        # same key → same mask (what makes per-node agreement work)
+        np.testing.assert_array_equal(
+            m, np.asarray(alive_mask(0, step, 8, 0.3)))
+    # rate ~0: exactly the forced-alive one; rate 1: everyone
+    assert np.asarray(alive_mask(0, 0, 8, 1e-9)).sum() == 1
+    assert np.asarray(alive_mask(0, 0, 8, 1.0)).sum() == 8
+
+
+def test_full_participation_identical_to_baseline():
+    """participation=1.0 must not change FedAvg at all (bitwise)."""
+    K = 4
+    rng = np.random.default_rng(0)
+    params0 = {"w": rng.normal(size=(K, 5)).astype(np.float32)}
+    grads = {"w": rng.normal(size=(K, 5)).astype(np.float32)}
+
+    outs = []
+    for part in (1.0, None):  # explicit participation=1 vs default ctor
+        strat = (FedAvgStrategy(OptimSpec("sgd", lr=0.1), H=1,
+                                participation=part)
+                 if part is not None
+                 else FedAvgStrategy(OptimSpec("sgd", lr=0.1), H=1))
+        rt, step_fn, params, state = make_harness(strat, K, dict(params0))
+        params, state, _ = step_fn(params, state, dict(grads), 1)
+        outs.append(jax.device_get(params)["w"])
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+def test_partial_participation_semantics_fedavg():
+    """Dead nodes keep their params; alive nodes get the alive-only mean."""
+    K = 8
+    part = 0.5
+    params0 = {"w": np.arange(K, dtype=np.float32).reshape(K, 1) * 10}
+    zero_g = {"w": np.zeros((K, 1), np.float32)}
+    strat = FedAvgStrategy(OptimSpec("sgd", lr=0.0), H=1,
+                           participation=part)
+    rt, step_fn, params, state = make_harness(strat, K, params0)
+    step = 1  # H=1 gate fires for step > 0
+    params, state, m = step_fn(params, state, zero_g, step)
+    out = jax.device_get(params)["w"].ravel()
+
+    alive = np.asarray(alive_mask(5678, step, K, part))
+    assert 1 <= alive.sum() < K  # the draw actually kills someone
+    expect_avg = (np.arange(K) * 10)[alive].mean()
+    for i in range(K):
+        if alive[i]:
+            np.testing.assert_allclose(out[i], expect_avg, rtol=1e-6)
+        else:
+            np.testing.assert_allclose(out[i], i * 10.0)
+    # dead nodes report zero comm bytes for the round
+    comm = np.asarray(m["comm_bytes"]).ravel()
+    assert np.all((comm > 0) == alive)
+
+
+def test_partial_participation_diloco_outer_state_stays_replicated():
+    """DiLoCo with failures: the outer master must stay identical across
+    nodes (dead nodes still compute the replicated outer step), while dead
+    nodes' params miss the sync."""
+    K = 4
+    part = 0.5
+    # replicas start identical (as real training does — same-seed init);
+    # per-node gradients then make them drift locally
+    params0 = {"w": np.ones((K, 1), np.float32)}
+    rng = np.random.default_rng(3)
+    strat = DiLoCoStrategy(OptimSpec("sgd", lr=0.1), H=2,
+                           participation=part)
+    rt, step_fn, params, state = make_harness(strat, K, params0)
+    for t in range(1, 5):
+        g = {"w": rng.normal(size=(K, 1)).astype(np.float32)}
+        params, state, _ = step_fn(params, state, g, t)
+    master = jax.device_get(state)["modules"][0]["master"]["w"]
+    for k in range(1, K):
+        np.testing.assert_array_equal(master[0], master[k])
+    # and the alive/dead split actually produced divergent replicas
+    out = jax.device_get(params)["w"].ravel()
+    assert len(set(np.round(out, 5))) > 1
+
+
+def test_partial_participation_sparta_runs_and_discriminates():
+    K = 4
+    params0 = {"w": np.arange(K * 4, dtype=np.float32).reshape(K, 4)}
+    zero_g = {"w": np.zeros((K, 4), np.float32)}
+    strat = SPARTAStrategy(OptimSpec("sgd", lr=0.0), p_sparta=1.0,
+                           participation=0.5)
+    rt, step_fn, params, state = make_harness(strat, K, params0)
+    step = 3
+    params, state, _ = step_fn(params, state, zero_g, step)
+    out = jax.device_get(params)["w"]
+    alive = np.asarray(alive_mask(5678, step, K, 0.5))
+    expect_avg = params0["w"][alive].mean(axis=0)
+    for i in range(K):
+        if alive[i]:
+            np.testing.assert_allclose(out[i], expect_avg, rtol=1e-6)
+        else:
+            np.testing.assert_allclose(out[i], params0["w"][i])
+
+
+def test_masked_mean_unit():
+    from gym_tpu.parallel import NodeRuntime
+
+    K = 4
+    rt = NodeRuntime.create(K)
+    vals = np.arange(K, dtype=np.float32).reshape(K, 1)
+    weights = np.array([1, 0, 1, 0], np.float32).reshape(K)
+
+    fn = rt.compile(lambda v, w: masked_mean(v, w, rt.ctx),
+                    donate_state=False)
+    out = np.asarray(fn(rt.shard_batch(vals), rt.shard_batch(weights)))
+    np.testing.assert_allclose(out, np.full((K, 1), 1.0))  # mean of {0, 2}
+
+
+class _PoisonModel(nn.Module):
+    """Loss goes NaN whenever the batch contains the sentinel value -1."""
+
+    @nn.compact
+    def __call__(self, batch, train: bool = True):
+        x, y = batch
+        x = x.reshape((x.shape[0], -1))
+        logits = nn.Dense(4)(x)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), y).mean()
+        # multiply (not select) so the NaN propagates into the GRADIENT:
+        # d(nan*loss)/dw = nan — a genuinely diverged replica
+        poisoned = jnp.any(x < -0.5)
+        return loss * jnp.where(poisoned, jnp.nan, 1.0)
+
+
+def test_skip_nonfinite_quarantines_poisoned_node():
+    """One node's NaN loss must not poison the grad pmean when
+    skip_nonfinite is on — and must when it's off (the failure the guard
+    exists for)."""
+    from gym_tpu.models.base import LossModel
+    from gym_tpu.parallel import NodeRuntime
+    from gym_tpu.strategy import SimpleReduceStrategy
+    from gym_tpu.train_node import make_init_fn, make_train_step
+
+    K = 4
+    rng = np.random.default_rng(0)
+    x = rng.normal(0.2, 0.1, size=(K, 1, 8, 3)).astype(np.float32)
+    y = rng.integers(0, 4, size=(K, 1, 8)).astype(np.int32)
+    x[2] = -1.0  # node 2 is poisoned
+
+    def run(skip):
+        rt = NodeRuntime.create(K)
+        lm = LossModel(_PoisonModel())
+        strat = SimpleReduceStrategy(OptimSpec("sgd", lr=0.1))
+        strat.finalize(2)
+        init = make_init_fn(lm, strat, (x[0, 0], y[0, 0]), seed=0)
+        state = rt.init_state(init)
+        step = rt.compile(make_train_step(lm, strat, rt.ctx,
+                                          skip_nonfinite=skip))
+        state, metrics = step(state, rt.shard_batch((x, y)))
+        return (jax.device_get(state.params),
+                jax.device_get(dict(metrics)))
+
+    params_ok, m_ok = run(True)
+    assert np.all(np.isfinite(jax.tree.leaves(params_ok)[0]))
+    np.testing.assert_array_equal(
+        np.asarray(m_ok["nonfinite"]).ravel(), [0, 0, 1, 0])
+
+    params_bad, _ = run(False)
+    assert not np.all(np.isfinite(np.asarray(
+        jax.tree.leaves(params_bad)[0])))
+
+
+def test_skip_nonfinite_surfaces_in_fit_history():
+    """The quarantine event reaches FitResult.history['nonfinite']."""
+    from gym_tpu.data import ArrayDataset
+    from gym_tpu.models.base import LossModel
+    from gym_tpu.trainer import Trainer
+    from gym_tpu.strategy import SimpleReduceStrategy
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(0.2, 0.1, size=(64, 8, 3)).astype(np.float32)
+    y = rng.integers(0, 4, size=(64,)).astype(np.int32)
+    x[::4] = -1.0  # every 4th sample is poisoned → some batches NaN
+
+    res = Trainer(LossModel(_PoisonModel()), ArrayDataset(x, y)).fit(
+        strategy=SimpleReduceStrategy(OptimSpec("sgd", lr=0.1)),
+        num_nodes=2, max_steps=4, batch_size=8, minibatch_size=8,
+        val_size=0, skip_nonfinite=True, show_progress=False,
+        log_dir="/tmp/gym_tpu_test_logs",
+    )
+    assert len(res.history["nonfinite"]) > 0
+    for leaf in jax.tree.leaves(res.params):
+        assert np.all(np.isfinite(leaf))
