@@ -43,11 +43,22 @@ def gen_run_name(args) -> str:
         parts.append(f"H{args.diloco_interval}")
     if args.strategy in ("sparta", "diloco_sparta"):
         parts.append(f"p{args.p_sparta}")
+    if getattr(args, "participation", 1.0) < 1.0:
+        parts.append(f"part{args.participation}")
     return "_".join(str(p) for p in parts)
 
 
 def create_strategy(args):
     """Strategy factory (reference ``example/nanogpt.py:138-245``)."""
+    if (getattr(args, "participation", 1.0) < 1.0
+            and args.strategy not in ("fedavg", "diloco", "sparta",
+                                      "diloco_sparta")):
+        # refuse rather than silently ignore — the parsed-but-unused flag
+        # bug class this framework exists to kill (SURVEY §5.6)
+        raise SystemExit(
+            f"--participation is not supported by --strategy "
+            f"{args.strategy} (fedavg/diloco/sparta/diloco_sparta only)"
+        )
     optim = OptimSpec("adamw", lr=args.lr)
     sched = dict(
         lr_scheduler="lambda_cosine",

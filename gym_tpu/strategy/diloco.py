@@ -55,6 +55,15 @@ class DiLoCoCommunicator(CommunicationModule):
         fault_seed: int = 5678,
     ):
         assert 0.0 < participation <= 1.0, participation
+        if shard_outer and participation < 1.0:
+            # a truly failed node could not serve its exclusive master
+            # shard for the all_gather reassembly, so the fault model is
+            # physically inconsistent with a node-sharded outer state
+            raise ValueError(
+                "shard_outer=True cannot be combined with participation<1: "
+                "dead nodes would still have to serve their master shard. "
+                "Use the replicated outer state for fault simulation."
+            )
         self.H = int(H)
         self.shard_outer = bool(shard_outer)
         self.participation = float(participation)
@@ -96,13 +105,11 @@ class DiLoCoCommunicator(CommunicationModule):
             EVERY node (the alive mask is shared-PRNG), so dead nodes'
             outer state cannot drift — they just skip the param sync and
             rejoin with stale local params."""
+            from .faults import masked_mean, participation_round
+            _, me_alive, group = participation_round(
+                self.fault_seed, step, self.participation, ctx)
             if self.participation >= 1.0:
-                return (ctx.pmean(params), jnp.asarray(True),
-                        jnp.asarray(float(k)))
-            from .faults import alive_mask, masked_mean
-            alive = alive_mask(self.fault_seed, step, k, self.participation)
-            me_alive = alive[ctx.node_index()]
-            group = jnp.sum(alive.astype(jnp.float32))
+                return ctx.pmean(params), me_alive, group
             return (masked_mean(params, me_alive.astype(jnp.float32), ctx),
                     me_alive, group)
 
@@ -118,11 +125,9 @@ class DiLoCoCommunicator(CommunicationModule):
             # all nodes sync to the new master (reference :47-49, :73-74 —
             # but without the broadcast: the computation is replicated);
             # a dead node misses the sync and keeps its local params
-            new_params = jax.tree.map(
-                lambda m, p: jnp.where(me_alive, m, p), master, params
-            )
-            comm = (me_alive * 2.0 * (group - 1)
-                    / jnp.maximum(group, 1) * psize)
+            from .faults import ring_bytes, sync_alive
+            new_params = sync_alive(master, params, me_alive)
+            comm = me_alive * ring_bytes(group, psize)
             return (new_params,
                     {"master": master, "outer_opt": outer_opt}, comm)
 

@@ -44,6 +44,34 @@ def alive_mask(seed: int, round_index, k: int, rate: float) -> jnp.ndarray:
     return alive.at[jnp.argmin(u)].set(True)
 
 
+def participation_round(seed: int, step, rate: float, ctx):
+    """One fault draw for a communication round: returns
+    ``(alive [k] bool, me_alive scalar bool, group f32 alive-count)``.
+    With ``rate >= 1`` (no failures) everyone is alive — callers can use
+    the same code path. The shared seed makes every node draw the same
+    mask (agreement without communication)."""
+    k = ctx.num_nodes
+    if rate >= 1.0:
+        return (jnp.ones((k,), bool), jnp.asarray(True),
+                jnp.asarray(float(k)))
+    alive = alive_mask(seed, step, k, rate)
+    me_alive = alive[ctx.node_index()]
+    group = jnp.sum(alive.astype(jnp.float32))
+    return alive, me_alive, group
+
+
+def sync_alive(new: PyTree, old: PyTree, me_alive) -> PyTree:
+    """Dead nodes miss the round: keep ``old`` where this node is down."""
+    return jax.tree.map(
+        lambda n, o: jnp.where(me_alive, n, o), new, old
+    )
+
+
+def ring_bytes(group, per_node_bytes):
+    """All-reduce ring cost over the alive group: 2(a−1)/a · bytes."""
+    return 2.0 * (group - 1) / jnp.maximum(group, 1) * per_node_bytes
+
+
 def masked_mean(tree: PyTree, weight, ctx) -> PyTree:
     """Mean over the node axis counting only nodes with ``weight`` 1
     (this node's scalar weight; dead nodes contribute zero). The SPMD form

@@ -45,7 +45,8 @@ class AveragingCommunicator(CommunicationModule):
         self.fault_seed = fault_seed
 
     def communicate(self, params, mstate, step, ctx):
-        from .faults import alive_mask, masked_mean
+        from .faults import (masked_mean, participation_round, ring_bytes,
+                             sync_alive)
 
         k = ctx.num_nodes
         if k == 1:
@@ -53,26 +54,16 @@ class AveragingCommunicator(CommunicationModule):
         psize = float(tree_bytes(params))
         isl = self.island_size if self.island_size is not None else k
         me = ctx.node_index()
-
-        if self.participation < 1.0:
-            alive = alive_mask(self.fault_seed, step, k, self.participation)
-            me_alive = alive[me]
-        else:
-            alive = jnp.ones((k,), bool)
-            me_alive = jnp.asarray(True)
+        alive, me_alive, group = participation_round(
+            self.fault_seed, step, self.participation, ctx)
 
         if isl >= k:
             # full averaging — the reference's fast path (:56-59), over
             # the alive subset; dead nodes keep their local params
             if self.participation < 1.0:
-                w = me_alive.astype(jnp.float32)
-                avg = masked_mean(params, w, ctx)
-                new = jax.tree.map(
-                    lambda a, p: jnp.where(me_alive, a, p), avg, params
-                )
-                a = jnp.sum(alive.astype(jnp.float32))
-                comm = me_alive * 2.0 * (a - 1) / jnp.maximum(a, 1) * psize
-                return new, mstate, comm
+                avg = masked_mean(params, me_alive.astype(jnp.float32), ctx)
+                return (sync_alive(avg, params, me_alive), mstate,
+                        me_alive * ring_bytes(group, psize))
             avg = ctx.pmean(params)
             comm = jnp.asarray(2.0 * (k - 1) / k * psize)
             return avg, mstate, comm
@@ -94,9 +85,7 @@ class AveragingCommunicator(CommunicationModule):
 
         avg = jax.tree.map(island_mean, gathered)
         if self.participation < 1.0:
-            avg = jax.tree.map(
-                lambda a, p: jnp.where(me_alive, a, p), avg, params
-            )
+            avg = sync_alive(avg, params, me_alive)
         # all_gather: each node transmits its full model once (:61-69)
         return avg, mstate, me_alive * psize
 

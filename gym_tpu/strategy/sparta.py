@@ -124,18 +124,15 @@ class SparseCommunicator(CommunicationModule):
             # call; with interval=1 iteration == step.
             iteration = step // self.interval
             masks = self.index_selector.masks(params, iteration)
-            k = ctx.num_nodes
+            from .faults import masked_mean, participation_round, ring_bytes
+
+            _, me_alive, group = participation_round(
+                self.fault_seed, step, self.participation, ctx)
             if self.participation < 1.0:
-                from .faults import alive_mask, masked_mean
-                alive = alive_mask(self.fault_seed, step, ctx.num_nodes,
-                                   self.participation)
-                me_alive = alive[ctx.node_index()]
                 avg = masked_mean(params, me_alive.astype(jnp.float32), ctx)
                 masks = jax.tree.map(lambda m: m & me_alive, masks)
-                group = jnp.sum(alive.astype(jnp.float32))  # ring is alive-only
             else:
                 avg = ctx.pmean(params)
-                group = jnp.asarray(float(k))
             new_params = jax.tree.map(
                 lambda m, a, p: jnp.where(m, a, p), masks, avg, params
             )
@@ -145,8 +142,7 @@ class SparseCommunicator(CommunicationModule):
                 for m, p in zip(jax.tree.leaves(masks),
                                 jax.tree.leaves(params))
             )
-            comm = 2.0 * (group - 1) / jnp.maximum(group, 1) * nbytes
-            return new_params, mstate, comm
+            return new_params, mstate, ring_bytes(group, nbytes)
 
         def skip(params, mstate):
             return params, mstate, jnp.zeros(())

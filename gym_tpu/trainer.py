@@ -320,9 +320,14 @@ class Trainer:
             nonlocal last_loss
             first_idx, m, count = p
             loss_a = np.asarray(m["loss"])[0].reshape(count)
-            comm_a = np.asarray(m["comm_bytes"])[0].reshape(count)
-            recv_a = (np.asarray(m["comm_recv_bytes"])[0].reshape(count)
-                      if "comm_recv_bytes" in m else None)
+            # loss is deliberately node 0's (the reference logs rank 0's,
+            # train_node.py:175-176); comm is the per-node MEAN — under
+            # partial participation it varies per node (dead nodes report
+            # 0) and a single node's draw would be a high-variance sample
+            comm_a = np.asarray(m["comm_bytes"]).mean(axis=0).reshape(count)
+            recv_a = (np.asarray(
+                m["comm_recv_bytes"]).mean(axis=0).reshape(count)
+                if "comm_recv_bytes" in m else None)
             # quarantine events: sum over the node axis (how many replicas
             # went non-finite this step)
             nf_a = (np.asarray(m["nonfinite"]).sum(axis=0).reshape(count)

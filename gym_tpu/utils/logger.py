@@ -54,9 +54,13 @@ class Logger:
             )
 
     def log_event(self, msg: str) -> None:
-        """One-off notable event (e.g. non-finite quarantine)."""
+        """One-off notable event (e.g. non-finite quarantine). Must stay
+        visible in headless runs — falls back to stdout when the progress
+        bar is off."""
         if self.pbar is not None:
             self.pbar.write(f"step {self.step}: {msg}")
+        else:
+            print(f"step {self.step}: {msg}")
 
     def increment_step(self) -> None:
         self.step += 1
