@@ -18,6 +18,7 @@ matmuls, and everything is static-shape for XLA.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Any, Optional, Tuple
 
@@ -61,6 +62,12 @@ class GPTConfig:
     moe_z_weight: float = 1e-3
     expert_axis: Optional[str] = None
     moe_impl: str = "auto"  # 'ragged' | 'einsum' | 'auto' (see models/moe.py)
+    # Autoregressive KV-cache decode mode (beyond-reference: the
+    # reference's `generate` re-runs the FULL context every token,
+    # nanogpt.py:410-439). With decode=True each __call__ consumes a chunk
+    # of new tokens, appends K/V to a per-layer cache ('cache' collection),
+    # and attends over cache+chunk — O(T) per new token instead of O(T²).
+    decode: bool = False
 
     def is_moe_layer(self, i: int) -> bool:
         return self.n_experts > 0 and i % self.moe_every == self.moe_every - 1
@@ -114,6 +121,14 @@ class CausalSelfAttention(nn.Module):
                        kernel_init=_init_normal(0.02), name="c_attn")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
+        if cfg.decode:
+            y = self._decode_attend(q, k, v, b, t, hd)
+            y = nn.Dense(c, use_bias=cfg.bias,
+                         kernel_init=_init_normal(
+                             0.02 / math.sqrt(2 * cfg.n_layer)),
+                         name="c_proj")(y)
+            return y
+
         drop_active = train and cfg.dropout > 0
         y = None
         if cfg.attn_impl == "flash" and not drop_active:
@@ -140,6 +155,44 @@ class CausalSelfAttention(nn.Module):
                      name="c_proj")(y)
         y = nn.Dropout(cfg.dropout, deterministic=not train)(y)
         return y
+
+    def _decode_attend(self, q, k, v, b, t, hd):
+        """KV-cache attention: append this chunk's K/V at the cache cursor
+        and attend each query over everything written so far. Works for a
+        multi-token prefill chunk and the 1-token decode steps alike."""
+        cfg = self.config
+        H, S = cfg.n_head, cfg.block_size
+
+        def heads(z):
+            return z.reshape(b, t, H, hd)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        ck = self.variable("cache", "k",
+                           lambda: jnp.zeros((b, S, H, hd), q.dtype))
+        cv = self.variable("cache", "v",
+                           lambda: jnp.zeros((b, S, H, hd), q.dtype))
+        ci = self.variable("cache", "i",
+                           lambda: jnp.zeros((), jnp.int32))
+        i = ci.value
+        k_all = jax.lax.dynamic_update_slice(ck.value, k, (0, i, 0, 0))
+        v_all = jax.lax.dynamic_update_slice(cv.value, v, (0, i, 0, 0))
+        ck.value, cv.value, ci.value = k_all, v_all, i + t
+
+        # scores over the FULL cache (static shape S); mask out unwritten
+        # slots and the causal future within this chunk
+        att = jnp.einsum("bqhd,bkhd->bhqk", q, k_all) / math.sqrt(hd)
+        row_pos = i + jnp.arange(t)[:, None]          # absolute query pos
+        col_pos = jnp.arange(S)[None, :]
+        mask = col_pos <= row_pos                      # [t, S]
+        att = jnp.where(mask[None, None], att.astype(jnp.float32),
+                        -jnp.inf)
+        att = jax.nn.softmax(att, axis=-1).astype(q.dtype)
+        y = jnp.einsum("bhqk,bkhd->bqhd", att, v_all)
+        # cache overflow (cursor past block_size) would silently clamp the
+        # dynamic_update_slice and overwrite recent K/V — poison the output
+        # instead so the failure is loud (a traced cursor can't `assert`)
+        y = jnp.where(i + t <= S, y, jnp.nan)
+        return y.reshape(b, t, H * hd)
 
 
 class MLP(nn.Module):
@@ -221,7 +274,15 @@ class GPT(nn.Module):
         assert t <= cfg.block_size, (
             f"sequence length {t} > block_size {cfg.block_size}"
         )
-        if cfg.seq_axis is not None:
+        if cfg.decode:
+            assert cfg.seq_axis is None and targets is None, (
+                "decode mode is single-device, logits-only"
+            )
+            pcache = self.variable("cache", "pos",
+                                   lambda: jnp.zeros((), jnp.int32))
+            pos = pcache.value + jnp.arange(t)[None, :]
+            pcache.value = pcache.value + t
+        elif cfg.seq_axis is not None:
             # chunked sequences only see their own K/V under dense/flash —
             # block-diagonal attention that would train silently wrong
             assert cfg.attn_impl == "ring", (
@@ -383,6 +444,76 @@ def generate(params: Any, config: GPTConfig, idx: np.ndarray,
         nxt = jax.random.categorical(sub, jnp.asarray(logits), axis=-1)
         idx = np.concatenate([idx, np.asarray(nxt)[:, None]], axis=1)
     return idx
+
+
+def generate_fast(params: Any, config: GPTConfig, idx: np.ndarray,
+                  max_new_tokens: int, temperature: float = 1.0,
+                  top_k: Optional[int] = None, seed: int = 0) -> np.ndarray:
+    """KV-cache autoregressive sampling (beyond-reference perf: the
+    reference's ``generate`` — and our parity ``generate`` above — re-runs
+    the full context per token, ``nanogpt.py:410-439``).
+
+    One jitted program: prefill fills the per-layer K/V caches from the
+    prompt, then a ``lax.scan`` samples token-by-token with O(T) attention
+    per step. Same sampling semantics as ``generate`` (temperature,
+    optional top-k, categorical)."""
+    idx = np.asarray(idx)
+    b, t0 = idx.shape
+    assert t0 + max_new_tokens <= config.block_size, (
+        f"prompt {t0} + {max_new_tokens} new tokens exceeds the cache "
+        f"(block_size {config.block_size})"
+    )
+    cfg = dataclasses.replace(config, decode=True, dropout=0.0,
+                              attn_impl="dense", seq_axis=None,
+                              remat=False, expert_axis=None)
+    decode_all = _cached_decode_program(
+        dataclasses.astuple(cfg), b, t0, max_new_tokens, temperature,
+        top_k,
+    )
+    new = np.asarray(decode_all(params, jnp.asarray(idx),
+                                jax.random.PRNGKey(seed)))
+    return np.concatenate([idx, new], axis=1)
+
+
+@functools.lru_cache(maxsize=32)
+def _cached_decode_program(cfg_tuple, b, t0, max_new_tokens, temperature,
+                           top_k):
+    """Compile the prefill+scan decode program once per (config, shape,
+    sampling) signature — a fresh ``jax.jit`` per ``generate_fast`` call
+    would recompile every time (~seconds of fixed overhead per call)."""
+    cfg = GPTConfig(*cfg_tuple)
+    model = GPT(cfg)
+
+    def sample(logits, key):
+        logits = logits.astype(jnp.float32) / temperature
+        if top_k is not None:
+            kk = min(top_k, logits.shape[-1])
+            kth = jax.lax.top_k(logits, kk)[0][..., -1]
+            logits = jnp.where(logits < kth[:, None], -jnp.inf, logits)
+        return jax.random.categorical(key, logits, axis=-1)
+
+    @jax.jit
+    def decode_all(params, prompt, key):
+        logits, varsc = model.apply({"params": params}, prompt,
+                                    train=False, mutable=["cache"])
+        keys = jax.random.split(key, max_new_tokens)
+        tok = sample(logits[:, -1], keys[0])
+
+        def body(carry, k):
+            cache, tok = carry
+            lg, vc = model.apply({"params": params, "cache": cache},
+                                 tok[:, None], train=False,
+                                 mutable=["cache"])
+            nxt = sample(lg[:, -1], k)
+            return (vc["cache"], nxt), tok
+
+        (_, last), toks = jax.lax.scan(
+            body, (varsc["cache"], tok), keys[1:]
+        )
+        toks = jnp.concatenate([toks.T, last[:, None]], axis=1)
+        return toks
+
+    return decode_all
 
 
 def from_pretrained(model_type: str, override_args: Optional[dict] = None):

@@ -11,6 +11,7 @@ from .communicate_optimize import (CommunicateOptimizeStrategy,
                                    CommunicationModule)
 from .demo import DeMoStrategy
 from .diloco import DiLoCoCommunicator, DiLoCoStrategy
+from .faults import alive_mask, masked_mean, participation_round
 from .fedavg import AveragingCommunicator, FedAvgStrategy
 from .optim import OptimSpec, ensure_optim_spec
 from .simple_reduce import SimpleReduceStrategy
@@ -40,4 +41,7 @@ __all__ = [
     "PartitionedIndexSelector",
     "SPARTADiLoCoStrategy",
     "DeMoStrategy",
+    "alive_mask",
+    "masked_mean",
+    "participation_round",
 ]
