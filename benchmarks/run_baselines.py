@@ -7,11 +7,13 @@ Runs the five configurations the driver tracks (BASELINE.md):
   4. nanoGPT 16-node FedAvg   (docs-char: real offline English)
   5. nanoGPT 64-node DeMo     (docs-char)
 
-and writes one JSON line per config plus `logs/baselines.json`.
-The reference's oracle is the same (SURVEY §4): final loss + it/s of the
-exact example configurations — convergence, not unit asserts.
+and writes one JSON line per config plus `<log_dir>/baselines.json`
+(default `logs/`). The reference's oracle is the same (SURVEY §4): final
+loss + it/s of the exact example configurations — convergence, not unit
+asserts.
 
 Usage: python benchmarks/run_baselines.py [--steps N] [--device tpu|cpu]
+           [--log_dir /tmp/smoke]   # keep smoke runs out of logs/
 """
 
 from __future__ import annotations
@@ -71,7 +73,7 @@ def gpt_cfg(strategy_name, num_nodes, steps):
     )
 
 
-def run_one(c, device, autocast):
+def run_one(c, device, autocast, log_dir="logs"):
     from gym_tpu import Trainer
 
     res = Trainer(c["model"], c["train"], c["val"]).fit(
@@ -81,6 +83,7 @@ def run_one(c, device, autocast):
         autocast=autocast, val_size=256,
         val_interval=max(1, c["max_steps"] // 4),
         show_progress=False, run_name=f"baseline_{c['name']}",
+        log_dir=log_dir,
     )
     comm = sum(b for _, b in res.history["comm_bytes"])
     out = {
@@ -104,6 +107,10 @@ def main():
     p.add_argument("--autocast", action="store_true")
     p.add_argument("--only", default=None,
                    help="substring filter on config names")
+    p.add_argument("--log_dir", default="logs",
+                   help="where run dirs + baselines.json go; point smoke "
+                        "runs at a scratch dir so they don't clobber the "
+                        "committed full-horizon evidence")
     args = p.parse_args()
     gpt_steps = args.gpt_steps or args.steps
 
@@ -118,9 +125,10 @@ def main():
     for c in configs:
         if args.only and args.only not in c["name"]:
             continue
-        results.append(run_one(c, args.device, args.autocast))
-    os.makedirs("logs", exist_ok=True)
-    with open("logs/baselines.json", "w") as f:
+        results.append(run_one(c, args.device, args.autocast,
+                               args.log_dir))
+    os.makedirs(args.log_dir, exist_ok=True)
+    with open(os.path.join(args.log_dir, "baselines.json"), "w") as f:
         json.dump(results, f, indent=2)
 
 
