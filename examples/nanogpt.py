@@ -45,6 +45,8 @@ def gen_run_name(args) -> str:
         parts.append(f"p{args.p_sparta}")
     if getattr(args, "participation", 1.0) < 1.0:
         parts.append(f"part{args.participation}")
+    if getattr(args, "n_experts", 0):
+        parts.append(f"moe{args.n_experts}e{args.expert_topk}")
     return "_".join(str(p) for p in parts)
 
 
