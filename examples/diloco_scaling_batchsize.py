@@ -26,7 +26,8 @@ H = 30
 BLOCK_SIZE = 256
 
 
-def run(mult: int, num_nodes: int, use_diloco: bool):
+def run(mult: int, num_nodes: int, use_diloco: bool,
+        budget: int = TOKEN_BUDGET):
     ds, vocab = get_dataset("shakespeare", BLOCK_SIZE, end_pc=0.9)
     val, _ = get_dataset("shakespeare", BLOCK_SIZE, start_pc=0.9)
     cfg = GPTConfig.gpt2_size_map("small")
@@ -35,7 +36,7 @@ def run(mult: int, num_nodes: int, use_diloco: bool):
 
     batch_size = BASE_BATCH * mult
     lr = BASE_LR * mult  # linear lr scaling (reference :79, :104)
-    max_steps = max(1, TOKEN_BUDGET // (batch_size * BLOCK_SIZE * num_nodes))
+    max_steps = max(1, budget // (batch_size * BLOCK_SIZE * num_nodes))
     if use_diloco:
         strategy = DiLoCoStrategy(optim_spec=OptimSpec("adamw", lr=lr), H=H)
     else:
@@ -56,13 +57,18 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--mults", type=int, nargs="+", default=[1, 2, 4, 8])
     p.add_argument("--nodes", type=int, nargs="+", default=[1, 2, 4])
+    p.add_argument("--budget", type=int, default=TOKEN_BUDGET,
+                   help="token budget per config (smoke runs: e.g. 65536)")
     args = p.parse_args()
     results = []
     for mult in args.mults:
-        results.append(run(mult, 1, use_diloco=False))  # DDP baseline
-        for k in args.nodes:
-            results.append(run(mult, k, use_diloco=True))
+        results.append(run(mult, 1, use_diloco=False,
+                           budget=args.budget))  # DDP baseline
         print(json.dumps(results[-1]))
+        for k in args.nodes:
+            results.append(run(mult, k, use_diloco=True,
+                               budget=args.budget))
+            print(json.dumps(results[-1]))
     with open("logs/scaling_results.json", "w") as f:
         json.dump(results, f, indent=2)
 

@@ -29,8 +29,11 @@ def dataset_factory(rank, num_nodes, is_val):
 
 def main():
     _, vocab_size = get_dataset("owt", BLOCK_SIZE, start_pc=0.0, end_pc=0.001)
+    # flash + bf16: 4 nodes × 8L/512 at T=1024 with dense f32 attention
+    # wants ~17 GB of probs in the backward — doesn't fit a 16 GB chip
     cfg = GPTConfig(block_size=BLOCK_SIZE, vocab_size=int(vocab_size),
-                    n_layer=8, n_head=8, n_embd=512, dropout=0.0)
+                    n_layer=8, n_head=8, n_embd=512, dropout=0.0,
+                    attn_impl="flash")
     res = Trainer(GPT(cfg), dataset_factory, dataset_factory).fit(
         max_steps=1000,
         strategy=DiLoCoStrategy(
@@ -39,8 +42,12 @@ def main():
             lr_scheduler_kwargs={"warmup_steps": 100}),
         num_nodes=NUM_NODES,
         batch_size=16,
+        minibatch_size=4,  # 50k-vocab f32 logits are 0.8 GB per 4-seq
+        # microbatch per node — the eval computes local AND consensus
+        # losses, so keep the in-flight logits small
         val_size=64,
         val_interval=100,
+        autocast=True,
         run_name="playground_diloco",
     )
     print(f"final loss {res.final_train_loss:.4f}")
