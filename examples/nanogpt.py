@@ -175,6 +175,9 @@ def main():
                         "(simulated failures; fedavg/diloco/sparta)")
     p.add_argument("--skip_nonfinite", action="store_true",
                    help="quarantine non-finite per-node gradients")
+    p.add_argument("--sample", type=int, default=0, metavar="N",
+                   help="after training, sample N tokens from the "
+                        "node-averaged model (KV-cache decoder)")
     args = p.parse_args()
 
     attn = args.attn_impl or ("ring" if args.cp > 1 else "dense")
@@ -235,6 +238,27 @@ def main():
     )
     print(f"final train loss {res.final_train_loss:.4f} "
           f"({res.steps_per_second:.2f} it/s)")
+
+    if args.sample:
+        from gym_tpu.data.build_dataset import CHAR_VOCAB
+        from gym_tpu.models.nanogpt import generate_fast
+
+        prompt = np.zeros((1, 1), np.int64)  # start from token 0
+        n_new = min(args.sample, cfg.block_size - 1)  # KV-cache capacity
+        if n_new < args.sample:
+            print(f"(clamping sample to {n_new} tokens — the KV cache "
+                  f"holds block_size={cfg.block_size})")
+        out = generate_fast(res.params, cfg, prompt, n_new,
+                            temperature=0.8, top_k=40, seed=args.seed)
+        toks = out[0, 1:].tolist()
+        if int(vocab_size) <= len(CHAR_VOCAB) + 1:  # char-level corpus
+            text = "".join(CHAR_VOCAB[t] if t < len(CHAR_VOCAB) else ""
+                           for t in toks)
+            print("--- sample ---")
+            print(text)
+        else:
+            print("--- sample (token ids) ---")
+            print(toks)
 
 
 if __name__ == "__main__":
