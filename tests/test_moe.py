@@ -217,17 +217,12 @@ def test_moe_expert_parallel_matches_single_device():
     np.testing.assert_allclose(ep, base, rtol=1e-5, atol=1e-6)
 
 
-def test_moe_fit_ep_matches_unsharded():
-    """Trainer-level expert parallelism: fit(ep=2) on a ('node','expert')
-    mesh reproduces the ep=1 loss trajectory exactly — sharding the experts
-    changes the schedule, not the math."""
+def _fit_moe_losses(tp: int, ep: int):
+    """One Trainer run of the shared MoE config at a (tp, ep) sharding."""
     from gym_tpu.data.gpt_datasets import ContiguousGPTTrainDataset
     from gym_tpu.strategy.optim import OptimSpec
     from gym_tpu.strategy.simple_reduce import SimpleReduceStrategy
     from gym_tpu.trainer import Trainer
-
-    if len(jax.devices()) < 4:
-        pytest.skip("needs >= 4 devices")
 
     rng = np.random.default_rng(1)
     data = rng.integers(0, 32, 2048, dtype=np.int64)
@@ -235,20 +230,33 @@ def test_moe_fit_ep_matches_unsharded():
     def factory(rank, num_nodes, is_val):
         return ContiguousGPTTrainDataset(data, block_size=16)
 
-    def run(ep):
-        cfg = GPTConfig(block_size=16, vocab_size=32, n_layer=2, n_head=2,
-                        n_embd=16, dropout=0.0, n_experts=4, expert_topk=2,
-                        expert_axis="expert" if ep > 1 else None)
-        res = Trainer(GPT(cfg), factory, factory).fit(
-            num_nodes=2,
-            strategy=SimpleReduceStrategy(OptimSpec("adamw", lr=1e-3)),
-            max_steps=6, batch_size=4, minibatch_size=4, val_size=16,
-            val_interval=6, ep=ep, show_progress=False,
-            log_dir="/tmp/gym_tpu_test_logs",
-        )
-        return [l for _, l in res.history["train_loss"]]
+    cfg = GPTConfig(block_size=16, vocab_size=32, n_layer=2, n_head=2,
+                    n_embd=16, dropout=0.0, n_experts=4, expert_topk=2,
+                    expert_axis="expert" if ep > 1 else None)
+    res = Trainer(GPT(cfg), factory, factory).fit(
+        num_nodes=2,
+        strategy=SimpleReduceStrategy(OptimSpec("adamw", lr=1e-3)),
+        max_steps=5, batch_size=4, minibatch_size=4, val_size=0,
+        tp=tp, ep=ep, show_progress=False,
+        log_dir="/tmp/gym_tpu_test_logs",
+    )
+    return [l for _, l in res.history["train_loss"]]
 
-    np.testing.assert_allclose(run(2), run(1), rtol=1e-4, atol=1e-5)
+
+@pytest.mark.parametrize("tp,ep", [(1, 2), (2, 2)])
+def test_moe_fit_sharded_matches_unsharded(tp, ep):
+    """Trainer-level expert parallelism — fit(ep=2) on a ('node','expert')
+    mesh — and the hybrid ('node','model','expert') TP×EP composition must
+    both reproduce the unsharded loss trajectory: sharding changes the
+    schedule, not the math. Precision pinned because TP resharding changes
+    matmul reduction order (same as tests/test_tensor_parallel.py)."""
+    if len(jax.devices()) < 2 * tp * ep:
+        pytest.skip(f"needs {2 * tp * ep} devices")
+    with jax.default_matmul_precision("highest"):
+        np.testing.assert_allclose(
+            _fit_moe_losses(tp, ep), _fit_moe_losses(1, 1),
+            rtol=2e-4, atol=1e-5,
+        )
 
 
 def test_moe_gpt_trains_on_node_mesh():
