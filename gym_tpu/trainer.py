@@ -210,8 +210,21 @@ class Trainer:
         # specs come from the model family's rules (GPT only for now).
         param_specs = None
         if tp > 1 or ep > 1:
+            # shape inference runs OUTSIDE the mesh program, where a
+            # seq-sharded model's axis_size('seq') query would be unbound
+            # (cp × ep composition) — param shapes don't depend on the
+            # sequence sharding, so trace a seq-axis-free clone
+            shape_model = loss_model
+            mod_cfg = getattr(loss_model.module, "config", None)
+            if getattr(mod_cfg, "seq_axis", None) is not None:
+                import dataclasses as _dc
+
+                from .models.nanogpt import GPT as _GPT
+                shape_model = LossModel(_GPT(_dc.replace(
+                    mod_cfg, seq_axis=None, attn_impl="dense")))
             shapes = jax.eval_shape(
-                lambda: loss_model.init(jax.random.PRNGKey(0), example_micro)
+                lambda: shape_model.init(jax.random.PRNGKey(0),
+                                         example_micro)
             )
         if tp > 1:
             from .models.nanogpt import GPT as _GPT

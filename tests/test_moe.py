@@ -217,8 +217,14 @@ def test_moe_expert_parallel_matches_single_device():
     np.testing.assert_allclose(ep, base, rtol=1e-5, atol=1e-6)
 
 
-def _fit_moe_losses(tp: int, ep: int):
-    """One Trainer run of the shared MoE config at a (tp, ep) sharding."""
+import functools
+
+
+@functools.lru_cache(maxsize=8)  # the (1,1,1) baseline is shared by cases
+def _fit_moe_losses(tp: int, ep: int, cp: int = 1):
+    """One Trainer run of the shared MoE config at a (tp, ep, cp)
+    sharding. val_size > 0 so the eval step (pmean of sharded params)
+    also runs under each sharding."""
     from gym_tpu.data.gpt_datasets import ContiguousGPTTrainDataset
     from gym_tpu.strategy.optim import OptimSpec
     from gym_tpu.strategy.simple_reduce import SimpleReduceStrategy
@@ -232,29 +238,34 @@ def _fit_moe_losses(tp: int, ep: int):
 
     cfg = GPTConfig(block_size=16, vocab_size=32, n_layer=2, n_head=2,
                     n_embd=16, dropout=0.0, n_experts=4, expert_topk=2,
-                    expert_axis="expert" if ep > 1 else None)
+                    expert_axis="expert" if ep > 1 else None,
+                    attn_impl="ring" if cp > 1 else "dense",
+                    seq_axis="seq" if cp > 1 else None)
     res = Trainer(GPT(cfg), factory, factory).fit(
         num_nodes=2,
         strategy=SimpleReduceStrategy(OptimSpec("adamw", lr=1e-3)),
-        max_steps=5, batch_size=4, minibatch_size=4, val_size=0,
-        tp=tp, ep=ep, show_progress=False,
+        max_steps=5, batch_size=4, minibatch_size=4, val_size=16,
+        val_interval=5, tp=tp, ep=ep, cp=cp, show_progress=False,
         log_dir="/tmp/gym_tpu_test_logs",
     )
-    return [l for _, l in res.history["train_loss"]]
+    assert np.isfinite(res.history["global_loss"][-1][1])
+    return tuple(l for _, l in res.history["train_loss"])
 
 
-@pytest.mark.parametrize("tp,ep", [(1, 2), (2, 2)])
-def test_moe_fit_sharded_matches_unsharded(tp, ep):
+@pytest.mark.parametrize("tp,ep,cp", [(1, 2, 1), (2, 2, 1), (1, 2, 2)])
+def test_moe_fit_sharded_matches_unsharded(tp, ep, cp):
     """Trainer-level expert parallelism — fit(ep=2) on a ('node','expert')
-    mesh — and the hybrid ('node','model','expert') TP×EP composition must
-    both reproduce the unsharded loss trajectory: sharding changes the
-    schedule, not the math. Precision pinned because TP resharding changes
-    matmul reduction order (same as tests/test_tensor_parallel.py)."""
-    if len(jax.devices()) < 2 * tp * ep:
-        pytest.skip(f"needs {2 * tp * ep} devices")
+    mesh — plus the hybrid TP×EP ('node','model','expert') and CP×EP
+    ('node','seq','expert': ring attention over sequence chunks with the
+    experts sharded — long-context MoE) compositions must all reproduce
+    the unsharded loss trajectory: sharding changes the schedule, not the
+    math. Precision pinned because resharding changes matmul reduction
+    order (same as tests/test_tensor_parallel.py)."""
+    if len(jax.devices()) < 2 * tp * ep * cp:
+        pytest.skip(f"needs {2 * tp * ep * cp} devices")
     with jax.default_matmul_precision("highest"):
         np.testing.assert_allclose(
-            _fit_moe_losses(tp, ep), _fit_moe_losses(1, 1),
+            _fit_moe_losses(tp, ep, cp), _fit_moe_losses(1, 1),
             rtol=2e-4, atol=1e-5,
         )
 
