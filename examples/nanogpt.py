@@ -168,6 +168,9 @@ def main():
     p.add_argument("--expert_topk", type=int, default=2)
     p.add_argument("--moe_every", type=int, default=2,
                    help="every Nth block is MoE (2 = alternate)")
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel devices per node (Megatron "
+                        "sharding over a GSPMD-auto 'model' axis)")
     p.add_argument("--ep", type=int, default=1,
                    help="expert-parallel devices (shards experts)")
     p.add_argument("--participation", type=float, default=1.0,
@@ -227,6 +230,7 @@ def main():
         batch_size=args.batch_size,
         minibatch_size=args.minibatch_size,
         cp=args.cp,
+        tp=args.tp,
         ep=args.ep,
         skip_nonfinite=args.skip_nonfinite,
         autocast=args.autocast,

@@ -157,8 +157,10 @@ class Trainer:
                     "seq_axis='seq' (and attn_impl='ring') on the model "
                     "config, or drop the cp argument."
                 )
-        if cp > 1 and tp > 1:
-            raise ValueError("cp and tp cannot be combined yet")
+        # cp (manual 'seq' axis) composes with the GSPMD-auto 'model' and
+        # 'expert' axes: shape inference uses a seq-axis-free clone below,
+        # and the parity matrix pins cp×tp and cp×ep against unsharded
+        # runs (tests/test_tensor_parallel.py, tests/test_moe.py)
         if ep > 1:
             n_exp = getattr(getattr(loss_model.module, "config", None),
                             "n_experts", 0)
