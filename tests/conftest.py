@@ -1,5 +1,7 @@
-"""Test harness: 8 virtual CPU devices — the JAX analog of the reference's
+"""Test harness: 16 virtual CPU devices — the JAX analog of the reference's
 "multi-node on one box" (mp.spawn + Gloo over localhost, SURVEY §4).
+16 (up from 8) so the full 4-axis sharding composition
+(node × seq × model × expert, 2 each) runs in the default suite.
 
 Must run before any JAX backend initialization. The environment's
 sitecustomize registers an 'axon' TPU backend and forces
@@ -9,7 +11,7 @@ sitecustomize registers an 'axon' TPU backend and forces
 import os
 
 os.environ["XLA_FLAGS"] = (
-    "--xla_force_host_platform_device_count=8 "
+    "--xla_force_host_platform_device_count=16 "
     + os.environ.get("XLA_FLAGS", "")
 )
 os.environ.setdefault("JAX_PLATFORMS", "cpu")

@@ -252,13 +252,14 @@ def _fit_moe_losses(tp: int, ep: int, cp: int = 1):
     return tuple(l for _, l in res.history["train_loss"])
 
 
-@pytest.mark.parametrize("tp,ep,cp", [(1, 2, 1), (2, 2, 1), (1, 2, 2)])
+@pytest.mark.parametrize("tp,ep,cp", [(1, 2, 1), (2, 2, 1), (1, 2, 2),
+                                      (2, 2, 2)])  # 4-axis: needs 16 devs
 def test_moe_fit_sharded_matches_unsharded(tp, ep, cp):
     """Trainer-level expert parallelism — fit(ep=2) on a ('node','expert')
-    mesh — plus the hybrid TP×EP ('node','model','expert') and CP×EP
-    ('node','seq','expert': ring attention over sequence chunks with the
-    experts sharded — long-context MoE) compositions must all reproduce
-    the unsharded loss trajectory: sharding changes the schedule, not the
+    mesh — plus the hybrid TP×EP ('node','model','expert'), CP×EP
+    ('node','seq','expert': long-context MoE), and the full 4-axis
+    ('node','seq','model','expert') compositions must all reproduce the
+    unsharded loss trajectory: sharding changes the schedule, not the
     math. Precision pinned because resharding changes matmul reduction
     order (same as tests/test_tensor_parallel.py)."""
     if len(jax.devices()) < 2 * tp * ep * cp:
