@@ -1,0 +1,53 @@
+"""The driver's multi-chip gate must be hermetic.
+
+Round 2's `MULTICHIP` artifact went red because a mid-flight libtpu upgrade
+broke the *default* accelerator backend, and the dryrun — a CPU-mesh
+correctness check — let eager ops touch that backend. These tests run
+``dryrun_multichip`` in a subprocess with the default backend deliberately
+poisoned (every non-CPU ``get_backend`` resolution raises, simulating the
+libtpu client/terminal mismatch) and assert the gate stays green.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+POISON_SCRIPT = """
+import jax
+import jax._src.xla_bridge as xb
+
+_orig = xb.get_backend
+def poisoned(platform=None):
+    if platform is None:
+        raise RuntimeError("POISONED: default backend (simulated libtpu mismatch)")
+    p = platform if isinstance(platform, str) else getattr(platform, "platform", platform)
+    if p != "cpu":
+        raise RuntimeError(f"POISONED: non-cpu backend {p!r}")
+    return _orig(platform)
+xb.get_backend = poisoned
+
+from __graft_entry__ import dryrun_multichip
+dryrun_multichip(8)
+print("DRYRUN_OK_POISONED")
+"""
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_survives_poisoned_default_backend():
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # let the default backend be whatever it is
+    # XLA flag parsing is last-wins: append so our count beats inherited ones
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    proc = subprocess.run(
+        [sys.executable, "-c", POISON_SCRIPT], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, (
+        f"dryrun touched the (poisoned) default backend:\n{proc.stderr[-4000:]}"
+    )
+    assert "DRYRUN_OK_POISONED" in proc.stdout
