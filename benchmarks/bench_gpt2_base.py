@@ -159,7 +159,7 @@ def main() -> None:
                     help="MoE: experts per MoE block (0 = dense)")
     ap.add_argument("--expert-topk", type=int, default=2)
     ap.add_argument("--moe-impl", default="auto",
-                    choices=["auto", "ragged", "einsum"])
+                    choices=["auto", "ragged", "einsum", "dense"])
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--spc", type=int, default=5,

@@ -59,7 +59,18 @@ def _is_batched(x) -> bool:
     try:
         from jax._src.core import get_axis_env
         from jax._src.interpreters.batching import BatchTracer
-    except ImportError:  # moved upstream: be conservative, use einsum
+    except ImportError:
+        # moved upstream: be conservative, report batched. Harmless for
+        # semantics — the batched fallback ('dense') computes the same
+        # objective as ragged — but dense costs E/topk× the FLOPs, so the
+        # silent perf downgrade on physical-node runs deserves a signal.
+        import warnings
+        warnings.warn(
+            "MoE vmap detection lost its private JAX internals "
+            "(jax._src moved); moe_impl='auto' now always uses the dense "
+            "dispatch (same objective as ragged, E/topk x the FLOPs). Pin "
+            "moe_impl='ragged' on physical-node runs to restore perf.",
+            stacklevel=3)
         return True
     from ..parallel.axis import VNODE_AXIS
     if VNODE_AXIS in get_axis_env().axis_sizes:
@@ -104,7 +115,15 @@ class MoEMLP(nn.Module):
     #       matmul per projection (the TPU-native MoE kernel path), combine
     #       by segment-sum. No capacity limit (no drops), O(S·K·C·H) only.
     #       Not EP-shardable (row→expert mapping is data-dependent).
-    #   'auto' — ragged when expert_axis is None, einsum under EP.
+    #   'dense' — every expert runs every token; the combine masks to the
+    #       selected top-k. Mathematically identical to 'ragged' (same
+    #       top-k selection + gate normalization, no drops) at E/K× its
+    #       FLOPs, but vmap-safe and static-shaped everywhere.
+    #   'auto' — einsum under EP (expert_axis set: the standard GShard
+    #       capacity semantics, an explicit *config* choice, not topology);
+    #       otherwise ragged on physical-node programs and dense under the
+    #       vmapped vnode axis — both drop-free and the same objective, so
+    #       how K simulated nodes fold onto devices cannot change the loss.
     moe_impl: str = "auto"
 
     @nn.compact
@@ -118,21 +137,13 @@ class MoEMLP(nn.Module):
 
         impl = self.moe_impl
         if impl == "auto":
-            impl = ("einsum" if self.expert_axis or _is_batched(x)
-                    else "ragged")
-            if impl == "einsum" and self.capacity_factor * S * K / E < S:
-                import warnings
-                warnings.warn(
-                    "MoE moe_impl='auto' selected the einsum dispatch "
-                    f"(capacity-limited: tokens past capacity_factor="
-                    f"{self.capacity_factor} are dropped), while physical-"
-                    "node runs of the same config use the ragged dispatch "
-                    "(no drops) — the training objective differs with "
-                    "topology. Pin moe_impl='einsum' (or raise "
-                    "capacity_factor to n_experts/topk) for "
-                    "topology-independent semantics.", stacklevel=2,
-                )
-        assert impl in ("einsum", "ragged"), impl
+            if self.expert_axis:
+                impl = "einsum"
+            elif _is_batched(x):
+                impl = "dense"
+            else:
+                impl = "ragged"
+        assert impl in ("einsum", "ragged", "dense"), impl
         assert not (impl == "ragged" and self.expert_axis), (
             "ragged MoE dispatch cannot shard experts (use moe_impl='einsum' "
             "for expert parallelism)"
@@ -163,8 +174,11 @@ class MoEMLP(nn.Module):
             except NotImplementedError:
                 # lax.ragged_dot has no general batching rule: under a
                 # vmapped node program (virtual nodes, K > devices) fall
-                # back to the one-hot dispatch
-                impl = "einsum"
+                # back to the dense all-experts dispatch — same objective
+                impl = "dense"
+        if impl == "dense":
+            return self._dense(xf, gates, logits, w_fc, b_fc, w_pr, b_pr,
+                               (B, T, C), train)
 
         capacity = min(int(math.ceil(self.capacity_factor * S * K / E)), S)
 
@@ -216,6 +230,37 @@ class MoEMLP(nn.Module):
         y = y.reshape(B, T, C)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         return y, self._aux(gates, logits, top1_mask.astype(jnp.float32), E)
+
+    def _dense(self, xf, gates, logits, w_fc, b_fc, w_pr, b_pr, shape,
+               train: bool) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Drop-free all-experts dispatch, mathematically identical to
+        ``_ragged`` (same ``top_k`` selection, same gate normalization, no
+        capacity limit): every expert runs every token and the combine
+        weights mask to the selected top-k. Costs E/topk× the ragged FLOPs
+        but is vmap-safe (no ``ragged_dot``) and static-shaped, so the
+        'auto' fallback under the vnode axis keeps the training objective
+        independent of how K simulated nodes fold onto devices."""
+        B, T, C = shape
+        E, K = self.n_experts, self.topk
+        dtype = xf.dtype
+        topg, topi = jax.lax.top_k(gates, K)                       # [S, K]
+        if K > 1:
+            topg = topg / jnp.maximum(topg.sum(-1, keepdims=True), 1e-9)
+        # [S, E] combine weights: normalized gate on the selected experts
+        w = jnp.sum(jax.nn.one_hot(topi, E, dtype=jnp.float32)
+                    * topg[..., None], axis=1)
+        h = jnp.einsum("sc,ech->esh", xf, w_fc.astype(dtype))
+        if b_fc is not None:
+            h = h + b_fc.astype(dtype)[:, None, :]
+        h = nn.gelu(h)
+        ye = jnp.einsum("esh,ehm->esm", h, w_pr.astype(dtype))
+        if b_pr is not None:
+            ye = ye + b_pr.astype(dtype)[:, None, :]
+        y = jnp.einsum("se,esm->sm", w.astype(dtype), ye)
+        y = y.reshape(B, T, C)
+        y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        top1_mask = jax.nn.one_hot(topi[:, 0], E, dtype=jnp.float32)
+        return y, self._aux(gates, logits, top1_mask, E)
 
     def _ragged(self, xf, gates, logits, w_fc, b_fc, w_pr, b_pr, shape,
                 train: bool) -> Tuple[jnp.ndarray, jnp.ndarray]:

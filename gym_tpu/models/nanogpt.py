@@ -61,7 +61,7 @@ class GPTConfig:
     moe_aux_weight: float = 1e-2
     moe_z_weight: float = 1e-3
     expert_axis: Optional[str] = None
-    moe_impl: str = "auto"  # 'ragged' | 'einsum' | 'auto' (see models/moe.py)
+    moe_impl: str = "auto"  # 'ragged'|'einsum'|'dense'|'auto' (models/moe.py)
     # Autoregressive KV-cache decode mode (beyond-reference: the
     # reference's `generate` re-runs the FULL context every token,
     # nanogpt.py:410-439). With decode=True each __call__ consumes a chunk
@@ -463,9 +463,14 @@ def generate_fast(params: Any, config: GPTConfig, idx: np.ndarray,
         f"prompt {t0} + {max_new_tokens} new tokens exceeds the cache "
         f"(block_size {config.block_size})"
     )
+    # moe_impl reset to 'auto' alongside expert_axis=None: a training
+    # config pinned to the capacity-limited 'einsum' dispatch must not
+    # drop tokens at decode (capacity is tiny at T=1), and with
+    # expert_axis cleared the drop-free ragged/dense paths are always legal
     cfg = dataclasses.replace(config, decode=True, dropout=0.0,
                               attn_impl="dense", seq_axis=None,
-                              remat=False, expert_axis=None)
+                              remat=False, expert_axis=None,
+                              moe_impl="auto")
     decode_all = _cached_decode_program(
         dataclasses.astuple(cfg), b, t0, max_new_tokens, temperature,
         top_k,
