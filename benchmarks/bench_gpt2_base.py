@@ -35,7 +35,8 @@ def measure(size: str = "base", nodes: int = 1, batch: int = 8,
             warmup: int = 3, spc: int = 5,
             peak_tflops: float = 197.0, shard_outer: bool = False,
             n_experts: int = 0, expert_topk: int = 2,
-            moe_impl: str = "auto") -> dict:
+            moe_impl: str = "auto", loss_chunk: int = 0,
+            demo_delta_bf16: bool = False) -> dict:
     """Build the GPT-2 ``size`` model, run ``steps`` training steps with
     ``strategy`` over ``nodes`` simulated nodes and return the measured
     {it/s, MFU, tokens/s, loss, ...} dict. Raises on OOM/compile failure
@@ -58,6 +59,7 @@ def measure(size: str = "base", nodes: int = 1, batch: int = 8,
         GPTConfig.gpt2_size_map(size),
         block_size=block, dropout=0.0, attn_impl=attn, remat=remat,
         n_experts=n_experts, expert_topk=expert_topk, moe_impl=moe_impl,
+        loss_chunk=loss_chunk,
     )
     loss_model = LossModel(GPT(cfg), jnp.bfloat16 if bf16 else None)
 
@@ -69,7 +71,9 @@ def measure(size: str = "base", nodes: int = 1, batch: int = 8,
         strat = ZeroReduceStrategy(OptimSpec("adamw", lr=3e-4))
     elif strategy == "demo":
         from gym_tpu.strategy.demo import DeMoStrategy
-        strat = DeMoStrategy(optim_spec=OptimSpec("sgd", lr=1e-3))
+        strat = DeMoStrategy(
+            optim_spec=OptimSpec("sgd", lr=1e-3),
+            delta_dtype=jnp.bfloat16 if demo_delta_bf16 else None)
     else:
         strat = SimpleReduceStrategy(OptimSpec("adamw", lr=3e-4))
 
@@ -135,6 +139,8 @@ def measure(size: str = "base", nodes: int = 1, batch: int = 8,
         "bf16": bf16,
         "strategy": strategy + ("+shard_outer" if shard_outer
                                 and strategy == "diloco" else ""),
+        **({"loss_chunk": loss_chunk} if loss_chunk else {}),
+        **({"demo_delta_bf16": True} if demo_delta_bf16 else {}),
         "warmup_s": round(t_compile, 1),
         "platform": jax.devices()[0].platform,
     }
@@ -160,6 +166,12 @@ def main() -> None:
     ap.add_argument("--expert-topk", type=int, default=2)
     ap.add_argument("--moe-impl", default="auto",
                     choices=["auto", "ragged", "einsum", "dense"])
+    ap.add_argument("--demo-delta-bf16", action="store_true",
+                    help="DeMo: store the momentum residual + staged "
+                         "grads in bf16 (halves strategy state memory)")
+    ap.add_argument("--loss-chunk", type=int, default=0,
+                    help="chunked cross-entropy rows (0 = one-shot logits;"
+                         " needed to fit many-node vmapped simulators)")
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--spc", type=int, default=5,
@@ -182,7 +194,8 @@ def main() -> None:
                      peak_tflops=args.peak_tflops,
                      shard_outer=args.shard_outer,
                      n_experts=args.n_experts, expert_topk=args.expert_topk,
-                     moe_impl=args.moe_impl)
+                     moe_impl=args.moe_impl, loss_chunk=args.loss_chunk,
+                     demo_delta_bf16=args.demo_delta_bf16)
     print(json.dumps(result))
     out_dir = os.path.dirname(args.out)
     if out_dir:

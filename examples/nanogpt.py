@@ -173,6 +173,9 @@ def main():
                         "sharding over a GSPMD-auto 'model' axis)")
     p.add_argument("--ep", type=int, default=1,
                    help="expert-parallel devices (shards experts)")
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline-parallel devices per node (GPipe stages;"
+                        " grad-accum microbatches are the pipeline's M)")
     p.add_argument("--participation", type=float, default=1.0,
                    help="fraction of nodes alive per comm round "
                         "(simulated failures; fedavg/diloco/sparta)")
@@ -232,6 +235,7 @@ def main():
         cp=args.cp,
         tp=args.tp,
         ep=args.ep,
+        pp=args.pp,
         skip_nonfinite=args.skip_nonfinite,
         autocast=args.autocast,
         seed=args.seed,

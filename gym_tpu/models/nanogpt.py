@@ -62,6 +62,14 @@ class GPTConfig:
     moe_z_weight: float = 1e-3
     expert_axis: Optional[str] = None
     moe_impl: str = "auto"  # 'ragged'|'einsum'|'dense'|'auto' (models/moe.py)
+    # Chunked cross-entropy: compute the lm_head matmul + CE over row
+    # chunks of `loss_chunk` tokens under `jax.checkpoint`, so the full
+    # [B·T, vocab] f32 logits tensor is never materialized (at GPT-2 base
+    # with T=1024 that tensor is ~200 MB per sequence — 12+ GB across a
+    # vmapped 8-node simulator, the actual cause of the "DeMo 8×base
+    # OOM" from the round-2 review). Costs one extra head matmul in the
+    # backward (remat); 0 = off (exact reference semantics, single pass).
+    loss_chunk: int = 0
     # Autoregressive KV-cache decode mode (beyond-reference: the
     # reference's `generate` re-runs the FULL context every token,
     # nanogpt.py:410-439). With decode=True each __call__ consumes a chunk
@@ -318,18 +326,11 @@ class GPT(nn.Module):
             else:
                 x = block_cls(cfg, name=f"h_{i}")(x, train)
         x = nn.LayerNorm(epsilon=1e-5, use_bias=cfg.bias, name="ln_f")(x)
-        # weight tying: lm_head = wteᵀ (reference :206-208)
-        logits = wte.attend(x.astype(wte.embedding.dtype))
         if targets is None:
-            return logits
-        logits = logits.astype(jnp.float32)
-        losses = optax.softmax_cross_entropy_with_integer_labels(
-            logits.reshape(-1, cfg.vocab_size),
-            jnp.maximum(targets.reshape(-1), 0),
-        )
-        valid = (targets.reshape(-1) >= 0).astype(jnp.float32)
-        loss_sum = jnp.sum(losses * valid)
-        count = jnp.sum(valid)
+            # weight tying: lm_head = wteᵀ (reference :206-208)
+            return wte.attend(x.astype(wte.embedding.dtype))
+        loss_sum, count = ce_sum_count(x, targets, wte.embedding,
+                                       cfg.loss_chunk)
         if cfg.seq_axis is not None:
             loss_sum = jax.lax.psum(loss_sum, cfg.seq_axis)
             count = jax.lax.psum(count, cfg.seq_axis)
@@ -348,6 +349,58 @@ class GPT(nn.Module):
 
 
 # -- model utilities (reference parity helpers) ----------------------------
+
+
+def ce_sum_count(x, targets, embedding, loss_chunk: int):
+    """(Σ masked CE, Σ valid) through the tied lm head — the single source
+    of the loss convention (head matmul in the embedding's dtype, f32 CE,
+    ``targets == -1`` masked) for both the dense ``GPT.__call__`` and the
+    pipelined head (``parallel/pipeline_model.py``)."""
+    if loss_chunk > 0:
+        return _chunked_ce(x, targets, embedding, loss_chunk)
+    v = embedding.shape[0]
+    logits = (x.astype(embedding.dtype) @ embedding.T).astype(jnp.float32)
+    losses = optax.softmax_cross_entropy_with_integer_labels(
+        logits.reshape(-1, v), jnp.maximum(targets.reshape(-1), 0))
+    valid = (targets.reshape(-1) >= 0).astype(jnp.float32)
+    return jnp.sum(losses * valid), jnp.sum(valid)
+
+
+def _chunked_ce(x, targets, embedding, chunk: int):
+    """(Σ masked CE, Σ valid) over `chunk`-token row blocks, never holding
+    more than [chunk, vocab] logits: each block runs head-matmul → f32 CE
+    under `jax.checkpoint` inside a `lax.scan`, so the backward recomputes
+    a block's logits instead of storing all of them. Same math as the
+    one-shot path (per-row logsumexp is independent of blocking; the sum
+    accumulates in f32)."""
+    V, C = embedding.shape[0], embedding.shape[1]
+    # same dtype rule as the one-shot wte.attend path: the head matmul
+    # runs in the embedding's dtype, CE in f32
+    xf = x.reshape(-1, C).astype(embedding.dtype)
+    tf = targets.reshape(-1)
+    s = xf.shape[0]
+    n_blocks = -(-s // chunk)
+    pad = n_blocks * chunk - s
+    # padded rows carry target −1 → masked out like the ignore_index rows
+    xf = jnp.pad(xf, ((0, pad), (0, 0)))
+    tf = jnp.pad(tf, (0, pad), constant_values=-1)
+    xb = xf.reshape(n_blocks, chunk, C)
+    tb = tf.reshape(n_blocks, chunk)
+
+    @jax.checkpoint
+    def block(carry, inp):
+        xs, ts = inp
+        logits = (xs @ embedding.T).astype(jnp.float32)
+        losses = optax.softmax_cross_entropy_with_integer_labels(
+            logits, jnp.maximum(ts, 0))
+        valid = (ts >= 0).astype(jnp.float32)
+        return (carry[0] + jnp.sum(losses * valid),
+                carry[1] + jnp.sum(valid)), None
+
+    (loss_sum, count), _ = jax.lax.scan(
+        block, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (xb, tb))
+    return loss_sum, count
 
 
 def num_params(params: Any, non_embedding: bool = True) -> int:

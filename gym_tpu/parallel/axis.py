@@ -31,6 +31,7 @@ VNODE_AXIS = "vnode"
 SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
 EXPERT_AXIS = "expert"
+PIPE_AXIS = "pipe"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -63,6 +64,13 @@ class AxisCtx:
     # all-to-alls (models/moe.py).
     ep_axes: tuple = ()
     ep_sizes: tuple = ()
+    # Pipeline-parallel mesh axes (manual, like seq): each node's layer
+    # trunk is split into stages over these; microbatch activations stream
+    # stage→stage via ppermute (parallel/pipeline.py). Stage-local params
+    # are sharded over the axis; replicated ("outer") param gradients must
+    # be pp_psum'd (train_node.make_pipeline_train_step).
+    pp_axes: tuple = ()
+    pp_sizes: tuple = ()
 
     # -- collectives ------------------------------------------------------
 
@@ -95,6 +103,18 @@ class AxisCtx:
             return x.reshape((k,) + x.shape[len(self.axes):])
 
         return jax.tree.map(gather, tree)
+
+    def reduce_scatter(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Summed 1/K chunk of a flat ``[K·shard]`` vector — the canonical
+        ZeRO-1 collective (reduce-scatter, (K−1)/K·|x| bytes vs psum's
+        2(K−1)/K). Only valid when the simulated-node dimension is a single
+        mesh axis (``lax.psum_scatter`` has no batching rule for the
+        vmapped vnode factor). Chunk ``i`` lands on axis index ``i``,
+        matching ``take_shard``'s linear-index slicing."""
+        assert len(self.axes) == 1, (
+            "reduce_scatter needs the pure mesh node axis (n_virt == 1)")
+        return lax.psum_scatter(x, self.axes[0], scatter_dimension=0,
+                                tiled=True)
 
     def node_index(self) -> jnp.ndarray:
         """Linear index of this simulated node in [0, K) (reference rank)."""
@@ -143,6 +163,24 @@ class AxisCtx:
         for name, size in zip(self.seq_axes, self.seq_sizes):
             idx = idx * size + lax.axis_index(name)
         return idx
+
+    # -- pipeline-parallel axis -------------------------------------------
+
+    @property
+    def pp(self) -> int:
+        """Pipeline group size (1 = no stage sharding)."""
+        n = 1
+        for s in self.pp_sizes:
+            n *= s
+        return n
+
+    def pp_psum(self, tree: PyTree) -> PyTree:
+        """Sum over the pipeline axes — combines the per-stage gradient
+        contributions to *replicated* params (embeddings touched by stage
+        0, the tied lm head by the last stage)."""
+        if not self.pp_axes:
+            return tree
+        return jax.tree.map(lambda x: lax.psum(x, self.pp_axes), tree)
 
 
 def single_node_ctx() -> AxisCtx:

@@ -19,8 +19,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .axis import (EXPERT_AXIS, MODEL_AXIS, NODE_AXIS, SEQ_AXIS, VNODE_AXIS,
-                   AxisCtx)
+from .axis import (EXPERT_AXIS, MODEL_AXIS, NODE_AXIS, PIPE_AXIS, SEQ_AXIS,
+                   VNODE_AXIS, AxisCtx)
 
 PyTree = Any
 
@@ -49,11 +49,12 @@ class NodeRuntime:
     cp: int = 1   # context-parallel group size (devices per 'seq' axis)
     tp: int = 1   # tensor-parallel group size (devices per 'model' axis)
     ep: int = 1   # expert-parallel group size (devices per 'expert' axis)
+    pp: int = 1   # pipeline-parallel group size (devices per 'pipe' axis)
 
     @classmethod
     def create(cls, num_nodes: int,
                devices: Sequence[jax.Device] | None = None, cp: int = 1,
-               tp: int = 1, ep: int = 1):
+               tp: int = 1, ep: int = 1, pp: int = 1):
         """``cp > 1`` adds a ``'seq'`` mesh axis: each simulated node's
         forward pass is context-parallel over ``cp`` devices (ring attention
         over ICI, SURVEY §5.7 resolution). ``tp > 1`` adds a ``'model'``
@@ -63,15 +64,19 @@ class NodeRuntime:
         ``with_sharding_constraint`` annotations and inserts the Megatron
         collectives itself. ``ep > 1`` likewise adds a GSPMD-auto
         ``'expert'`` axis for MoE expert sharding (``models/moe.py``) —
-        XLA inserts the dispatch/combine all-to-alls. Mesh is
-        [P, cp?, tp?, ep?]; P·cp·tp·ep ≤ devices."""
+        XLA inserts the dispatch/combine all-to-alls. ``pp > 1`` adds a
+        manual ``'pipe'`` axis: each node's layer trunk is GPipe-split
+        into ``pp`` stages (``parallel/pipeline.py``), stage params
+        sharded over the axis. Mesh is [P, cp?, tp?, ep?, pp?];
+        P·cp·tp·ep·pp ≤ devices."""
         if devices is None:
             devices = jax.devices()
-        assert len(devices) >= cp * tp * ep, (
-            f"cp={cp}×tp={tp}×ep={ep} does not fit {len(devices)} devices"
+        assert len(devices) >= cp * tp * ep * pp, (
+            f"cp={cp}*tp={tp}*ep={ep}*pp={pp} does not fit "
+            f"{len(devices)} devices"
         )
-        n_phys = _largest_divisor_at_most(num_nodes,
-                                          len(devices) // (cp * tp * ep))
+        n_phys = _largest_divisor_at_most(
+            num_nodes, len(devices) // (cp * tp * ep * pp))
         n_virt = num_nodes // n_phys
         axes = [NODE_AXIS]
         dims = [n_phys]
@@ -84,6 +89,9 @@ class NodeRuntime:
         if ep > 1:
             axes.append(EXPERT_AXIS)
             dims.append(ep)
+        if pp > 1:
+            axes.append(PIPE_AXIS)
+            dims.append(pp)
         grid = np.asarray(devices[: int(np.prod(dims))]).reshape(dims)
         mesh = Mesh(grid, tuple(axes))
         ctx = AxisCtx(
@@ -100,9 +108,11 @@ class NodeRuntime:
             tp_sizes=(tp,) if tp > 1 else (),
             ep_axes=(EXPERT_AXIS,) if ep > 1 else (),
             ep_sizes=(ep,) if ep > 1 else (),
+            pp_axes=(PIPE_AXIS,) if pp > 1 else (),
+            pp_sizes=(pp,) if pp > 1 else (),
         )
         return cls(num_nodes=num_nodes, mesh=mesh, n_phys=n_phys,
-                   n_virt=n_virt, ctx=ctx, cp=cp, tp=tp, ep=ep)
+                   n_virt=n_virt, ctx=ctx, cp=cp, tp=tp, ep=ep, pp=pp)
 
     # -- sharding helpers -------------------------------------------------
 
@@ -129,13 +139,20 @@ class NodeRuntime:
         *,
         donate_state: bool = True,
         n_state_args: int = 1,
+        in_specs=None,
+        out_specs=None,
     ):
         """Compile a per-node function into the K-node SPMD program.
 
         ``node_fn(*args)`` sees the *single-node* view of each argument
         (leading K axis stripped) and may use ``self.ctx`` collectives.
         Returns a jitted function over global arrays with leading axis K.
-        """
+
+        ``in_specs`` / ``out_specs``: optional ``shard_map`` spec overrides
+        (pytree prefixes per argument / output). Defaults to
+        ``P('node')`` everywhere — override for state whose leaves are
+        additionally sharded over another manual axis (the pipeline's
+        stage-stacked params, ``P('node', 'pipe')``)."""
         ctx = self.ctx
 
         if self.n_virt > 1:
@@ -150,16 +167,18 @@ class NodeRuntime:
                 out = node_fn(*sq)
                 return jax.tree.map(lambda x: jnp.asarray(x)[None], out)
 
-        # manual over node/seq; 'model'/'expert' axes (if any) stay GSPMD-auto
+        # manual over node/seq/pipe; 'model'/'expert' axes stay GSPMD-auto
         manual = frozenset(self.mesh.axis_names) - {MODEL_AXIS, EXPERT_AXIS}
 
         def program(*args):
             n_in = len(args)
+            ins = in_specs if in_specs is not None else (P(NODE_AXIS),) * n_in
             return jax.shard_map(
                 block_fn,
                 mesh=self.mesh,
-                in_specs=(P(NODE_AXIS),) * n_in,
-                out_specs=P(NODE_AXIS),
+                in_specs=ins,
+                out_specs=(out_specs if out_specs is not None
+                           else P(NODE_AXIS)),
                 axis_names=manual,
                 check_vma=False,
             )(*args)
@@ -167,20 +186,24 @@ class NodeRuntime:
         donate = tuple(range(n_state_args)) if donate_state else ()
         return jax.jit(program, donate_argnums=donate)
 
-    def init_state(self, init_fn: Callable[[jnp.ndarray], PyTree]) -> PyTree:
+    def init_state(self, init_fn: Callable[[jnp.ndarray], PyTree],
+                   state_specs=None) -> PyTree:
         """Build per-node initial state: ``init_fn(node_index) -> state``.
 
         Parameters must be *identical* across nodes when ``init_fn`` ignores
         asymmetry — this replaces the reference's initial parameter broadcast
         from rank 0 (``exogym/train_node.py:101-104``): replicas constructed
         from the same seed are identical by determinism, no collective needed.
-        """
+
+        ``state_specs``: output spec override (see ``compile``) for state
+        sharded over more than the node axis."""
         ctx = self.ctx
 
         def node_init(_):
             return init_fn(ctx.node_index())
 
-        program = self.compile(node_init, donate_state=False)
+        program = self.compile(node_init, donate_state=False,
+                               out_specs=state_specs)
         dummy = self.shard_batch(np.zeros((self.num_nodes,), np.int32))
         return program(dummy)
 

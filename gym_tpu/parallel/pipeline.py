@@ -39,6 +39,7 @@ def pipeline_apply(
     xs: jnp.ndarray,
     n_stages: int,
     axis_name: str = PIPE_AXIS,
+    replicate_out: bool = True,
 ) -> jnp.ndarray:
     """Run M microbatches through S = ``n_stages`` pipeline stages.
 
@@ -50,6 +51,14 @@ def pipeline_apply(
     every stage; only stage 0 reads them). Returns [M, ...] — the last
     stage's outputs, shared to every stage via a masked ``psum`` so the
     caller can continue with replicated compute (loss head, logging).
+
+    ``replicate_out=False`` skips that psum and returns each stage's raw
+    output buffer — only the LAST stage's is meaningful. Use when the
+    caller masks the downstream compute to the last stage anyway (the
+    trainer's pipelined loss head does, so that replicated-parameter
+    gradients can be combined with ONE psum over the pipe axis without
+    double-counting the tied embedding: see
+    ``train_node.make_pipeline_train_step``).
     """
     assert jax.lax.axis_size(axis_name) == n_stages, (
         f"pipe axis '{axis_name}' has size {jax.lax.axis_size(axis_name)} "
@@ -89,6 +98,8 @@ def pipeline_apply(
     inbox0 = _vary(jnp.zeros_like(xs[0]))
     (_, out), _ = lax.scan(tick, (inbox0, out0),
                            jnp.arange(m + n_stages - 1))
+    if not replicate_out:
+        return out
     # only the last stage holds real outputs; share them with every stage
     return lax.psum(jnp.where(is_last, out, jnp.zeros_like(out)), axis_name)
 

@@ -1,0 +1,186 @@
+"""Pipelined GPT: the full model (embeddings → staged trunk → tied head)
+through the GPipe schedule, as a trainer capability.
+
+The reference has no pipeline parallelism (SURVEY §2.3 ❌ row). Round 2
+shipped the schedule as a library (``parallel/pipeline.py``); this module
+promotes it to ``Trainer.fit(pp=...)``: the SAME GPT as the dense model —
+identical init, identical loss — with the layer trunk split into ``pp``
+stages over a manual ``'pipe'`` mesh axis and grad-accumulation
+microbatches streamed through as the pipeline's M.
+
+Parameter layout: ``{"outer": {wte, wpe, ln_f}, "stages": stacked}`` where
+``stacked`` has leading axes [S, L/S, ...] sharded ``P('node', 'pipe')``.
+Placement follows the classic split — embeddings are *computed* by stage 0
+(every device runs the lookup, but only stage 0's result enters the
+pipeline), the loss head (ln_f + tied lm head + CE) is *masked to the last
+stage* and the scalar loss shared with one psum. That masking is what
+makes gradient combination exact: each outer parameter's contribution is
+computed on exactly one stage (wte: embed on stage 0 + tied head on stage
+S−1), so ``ctx.pp_psum`` of the outer grads is the true total — no
+double-counting of replicated compute.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..models.nanogpt import Block, GPT, GPTConfig, ce_sum_count
+from .axis import NODE_AXIS, PIPE_AXIS
+from .pipeline import (apply_stage_layers, pipeline_apply,
+                       stack_stage_params, take_stage)
+
+PyTree = Any
+
+
+def split_gpt_params(params: PyTree, n_stages: int, n_layer: int) -> PyTree:
+    """Plain GPT param tree → ``{"outer", "stages"}`` pipeline layout."""
+    per_layer = [params[f"h_{i}"] for i in range(n_layer)]
+    outer = {k: v for k, v in params.items() if not k.startswith("h_")}
+    return {"outer": outer,
+            "stages": stack_stage_params(per_layer, n_stages)}
+
+
+def merge_gpt_params(params: PyTree, n_layer: int) -> PyTree:
+    """Inverse of ``split_gpt_params`` — back to the plain GPT tree (so
+    ``fit(pp=...).params`` feeds ``generate`` / checkpoint-compat tooling
+    exactly like a ``pp=1`` result)."""
+    stages = params["stages"]
+    flat = jax.tree.map(
+        lambda x: x.reshape((n_layer,) + x.shape[2:]), stages)
+    out = dict(params["outer"])
+    for i in range(n_layer):
+        out[f"h_{i}"] = jax.tree.map(lambda x: x[i], flat)
+    return out
+
+
+class PipelinedGPTLossModel:
+    """LossModel-shaped adapter for the pipelined GPT.
+
+    ``init`` builds the *plain* GPT parameters from the same seed as a
+    ``pp=1`` run (bit-identical starting point), then repacks them into the
+    pipeline layout with each device keeping its own stage slice.
+    ``pipe_loss`` consumes ALL grad-accum microbatches at once — they are
+    the pipeline's M (GPipe bubble fraction (S−1)/(M+S−1)).
+    """
+
+    def __init__(self, config: GPTConfig, n_stages: int,
+                 compute_dtype: Optional[Any] = None):
+        assert config.n_layer % n_stages == 0, (
+            f"n_layer={config.n_layer} not divisible by pp={n_stages}")
+        assert config.dropout == 0.0, (
+            "pipeline parallelism requires dropout=0 (per-tick rng plumbing "
+            "through the schedule is not supported)")
+        assert config.n_experts == 0, "pp does not compose with MoE yet"
+        assert config.seq_axis is None, "pp does not compose with cp yet"
+        self.config = config
+        self.n_stages = n_stages
+        self.compute_dtype = compute_dtype
+        # .module: the underlying GPT, for config capture / MFU in the
+        # trainer (same attribute contract as LossModel)
+        self.module = GPT(config)
+
+    def init(self, rng: jax.Array, example_micro,
+             static_stage: Optional[int] = None) -> Tuple[PyTree, PyTree]:
+        """Full-model init (identical weights to ``pp=1``), split, and
+        sliced to this device's stage. ``static_stage`` pins the slice for
+        shape inference outside ``shard_map``; inside, the stage comes from
+        ``lax.axis_index('pipe')``."""
+        p_rng, d_rng = jax.random.split(rng)
+        variables = self.module.init(
+            {"params": p_rng, "dropout": d_rng}, example_micro, train=False)
+        split = split_gpt_params(dict(variables["params"]),
+                                 self.n_stages, self.config.n_layer)
+        sid = (static_stage if static_stage is not None
+               else lax.axis_index(PIPE_AXIS))
+        local = jax.tree.map(
+            lambda x: lax.dynamic_slice_in_dim(x, sid, 1, axis=0),
+            split["stages"])
+        return {"outer": split["outer"], "stages": local}, {}
+
+    def pipe_loss_local(self, params: PyTree, model_state: PyTree,
+                        batch: PyTree, rng: jax.Array,
+                        train: bool) -> Tuple[jnp.ndarray, PyTree]:
+        """This stage's share of the token-mean CE over all M microbatches
+        — nonzero only on the LAST stage; ``lax.psum`` over ``'pipe'``
+        yields the model loss. Differentiate THIS (not the psum'd scalar):
+        the gradient seed then has a single source (the last stage's
+        masked head), so cotangents reach every stage exactly once through
+        the transposed schedule — seeding a psum-replicated scalar on all
+        S devices over-counts the head path S× under the unchecked
+        shard_map transpose (pinned by
+        ``tests/test_pipeline.py::test_fit_pp2_params_match_pp1_one_sgd_step``).
+        """
+        cfg = self.config
+        idx, targets = batch
+        m, b, t = idx.shape
+        outer = params["outer"]
+        stages = take_stage(params["stages"])
+        if self.compute_dtype is not None:
+            cast = lambda tree: jax.tree.map(
+                lambda x: x.astype(self.compute_dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+            outer, stages = cast(outer), cast(stages)
+
+        wte = outer["wte"]["embedding"]
+        wpe = outer["wpe"]["embedding"]
+        x = wte[idx] + wpe[jnp.arange(t)][None, None]      # [M, B, T, C]
+
+        block = Block(cfg)
+        stage_fn = functools.partial(
+            apply_stage_layers,
+            lambda lp, h: block.apply({"params": lp}, h, train))
+        hs = pipeline_apply(stage_fn, stages, x, self.n_stages,
+                            replicate_out=False)            # [M, B, T, C]
+
+        sid = lax.axis_index(PIPE_AXIS)
+        is_last = sid == self.n_stages - 1
+        # non-last stages hold garbage buffers: zero them BEFORE the head
+        # so no NaN can leak into the masked branch's gradient (0·NaN=NaN)
+        hs = jnp.where(is_last, hs, jnp.zeros_like(hs))
+        ln = _apply_ln_f(hs, outer["ln_f"], cfg)
+        # per-microbatch token-means averaged over M — the SAME weighting
+        # as the pp=1 grad-accum scan (a pooled token-mean would diverge
+        # whenever ignore_index counts differ across microbatches)
+        sums, counts = jax.vmap(
+            lambda xm, tm: ce_sum_count(xm, tm, wte, cfg.loss_chunk)
+        )(ln, targets)                                     # [M], [M]
+        mean_loss = jnp.mean(sums / jnp.maximum(counts, 1.0))
+        local = jnp.where(is_last, mean_loss, 0.0)
+        return jnp.asarray(local, jnp.float32), model_state
+
+    def pipe_loss(self, params: PyTree, model_state: PyTree, batch: PyTree,
+                  rng: jax.Array, train: bool) -> Tuple[jnp.ndarray, PyTree]:
+        """Replicated scalar loss (for eval / metrics — do not
+        differentiate; see ``pipe_loss_local``)."""
+        local, model_state = self.pipe_loss_local(params, model_state,
+                                                  batch, rng, train)
+        return lax.psum(local, PIPE_AXIS), model_state
+
+
+def _apply_ln_f(x, ln_params, cfg: GPTConfig):
+    ln = nn.LayerNorm(epsilon=1e-5, use_bias=cfg.bias)
+    return ln.apply({"params": ln_params}, x)
+
+
+def pipeline_state_specs(state_shapes) -> PyTree:
+    """PartitionSpec tree for a pipelined TrainState: every leaf under a
+    ``stages`` subtree is ``P('node', 'pipe')`` (leading node axis, then
+    the stage-stacked axis), everything else ``P('node')``. Strategy state
+    that mirrors the param tree (DiLoCo's master, optax moments) inherits
+    the right spec through its own ``stages`` keys."""
+    from jax.sharding import PartitionSpec as P
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(state_shapes)
+    out = []
+    for path, _ in flat:
+        keys = [str(getattr(k, "key", getattr(k, "name", k)))
+                for k in path]
+        out.append(P(NODE_AXIS, PIPE_AXIS) if "stages" in keys
+                   else P(NODE_AXIS))
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -53,6 +53,8 @@ class DeMoStrategy(Strategy):
         max_norm: Optional[float] = None,
         lr_scheduler=None,
         lr_scheduler_kwargs=None,
+        segment_bytes: int = 256 * 1024 * 1024,
+        delta_dtype=None,
     ):
         super().__init__(lr_scheduler, lr_scheduler_kwargs, max_norm)
         # the spec only carries lr (DeMo is SGD-based; reference demo.py:37)
@@ -65,6 +67,23 @@ class DeMoStrategy(Strategy):
         self.compression_topk = int(compression_topk)
         self.compression_chunk = int(compression_chunk)
         self.weight_decay = float(weight_decay)
+        # Transient-memory bound for the encode/decode pipelines: a tile
+        # signature whose pooled [G, a, b] f32 tensor (per simulated node)
+        # exceeds this is processed in unrolled slice segments, so the
+        # step's peak extra memory is O(segment) instead of O(model) per
+        # phase — one half (with the model's chunked CE,
+        # GPTConfig.loss_chunk) of fitting 8×GPT-2-base DeMo on one chip.
+        # Identical math at any segmentation (tests/test_demo.py); 0
+        # disables.
+        self.segment_bytes = int(segment_bytes)
+        # Storage dtype for the momentum residual and the staged chunked
+        # gradient (None = f32, exact reference numerics). jnp.bfloat16
+        # halves the strategy's resident state AND lets the incoming f32
+        # gradient buffer die before the encode pipeline runs — the memory
+        # trade that fits the 8-node GPT-2-base simulation on one 16 GB
+        # chip (a config where round 2 could not run ANY strategy). The
+        # encode itself still computes in f32.
+        self.delta_dtype = delta_dtype
 
     def _build(self):
         pass  # no optax transform: the update rule is DeMo itself
@@ -83,18 +102,33 @@ class DeMoStrategy(Strategy):
     def init(self, params: PyTree) -> PyTree:
         assert self._finalized, "call strategy.finalize(max_steps) first"
         # The momentum residual lives PRE-CHUNKED, pooled per tile
-        # signature ("{a}x{b}" → [G, a, b] f32), not in leaf layout: the
-        # whole momentum/DCT/top-k/residual pipeline then runs as a handful
-        # of big batched ops per step instead of ~6 small ops × n_leaves
+        # signature ("{a}x{b}" → [G, a·b]), not in leaf layout: the whole
+        # momentum/DCT/top-k/residual pipeline then runs as a handful of
+        # big batched ops per step instead of ~6 small ops × n_leaves
         # (profiled on the chip: the per-leaf loop was ~3k fusions/step at
         # GPT-base, more wall time than the model's forward+backward).
+        # Flat [G, a·b] rather than [G, a, b]: the TPU (8, 128) tile
+        # layout pads a 64-wide minor dim to 128 lanes — 2× wasted HBM on
+        # every pooled buffer at the default chunk size.
         p_leaves, _ = jax.tree.flatten(params)
         codecs, groups = self._groups(p_leaves)
+        dt = self.delta_dtype or jnp.float32
         return {"delta": {
             f"{a}x{b}": jnp.zeros(
-                (sum(codecs[i].n_chunks for i in ids), a, b), jnp.float32)
+                (sum(codecs[i].n_chunks for i in ids), a * b), dt)
             for (a, b), ids in groups.items()
         }}
+
+    def _n_segments(self, n_chunks: int, a: int, b: int) -> int:
+        """Segments needed to keep one [·, a, b] f32 working set under
+        ``segment_bytes`` (per simulated node). Counts the TPU (8, 128)
+        tile padding — the per-segment decode temps are [·, a, b] and a
+        64-wide minor dim occupies 128 lanes of HBM."""
+        if self.segment_bytes <= 0:
+            return 1
+        pad_a, pad_b = max(a, 8), max(b, 128)
+        return max(1,
+                   -(-(n_chunks * pad_a * pad_b * 4) // self.segment_bytes))
 
     def _lr(self, step):
         base = self.optim_spec.lr
@@ -121,26 +155,66 @@ class DeMoStrategy(Strategy):
         # tensor. Profiled on the chip: this and the two-stage top-k
         # (ops/topk_compress.py) took the DeMo-base step from 37%+ spent
         # in per-leaf sorts to a handful of large ops.
+        stage_dt = self.delta_dtype or jnp.float32
         new_delta = {}
         decoded_chunks = {}
         comm_tx = 0.0
         for (a, b), leaf_ids in groups.items():
             key = f"{a}x{b}"
             d_a, d_b = dct_matrix(a), dct_matrix(b)
+            # staged in the storage dtype: with delta_dtype=bf16 the f32
+            # gradient buffers die here, before the encode pipeline runs
             g_cat = jnp.concatenate(
                 [codecs[i].to_chunks(
-                    g_leaves[i].reshape(codecs[i].shape).astype(jnp.float32))
-                 for i in leaf_ids], axis=0)              # [G, a, b]
-            delta = beta * state["delta"][key] + lr * g_cat
-            coeffs = encode_chunks(delta, d_a, d_b)       # [G, a·b]
-            idx, val = topk_compress(coeffs, topk)        # [G, k]
+                    g_leaves[i].reshape(codecs[i].shape).astype(stage_dt))
+                 .reshape(codecs[i].n_chunks, a * b)
+                 for i in leaf_ids], axis=0)              # [G, a·b]
+            n_chunks = g_cat.shape[0]
+            n_seg = self._n_segments(n_chunks, a, b)
+
+            def encode_one(d_seg, g_seg):
+                # phases 1+2 (per segment, f32 whatever the storage
+                # dtype): momentum decay+accumulate, DCT, top-k, residual
+                # correction — subtract own transmitted estimate
+                # (reference demo.py:170-180; own picks are distinct
+                # within a chunk, so mean == identity and the estimate
+                # decodes sparsely: no dense grid, no counts)
+                delta = (beta * d_seg.astype(jnp.float32)
+                         + lr * g_seg.astype(jnp.float32))
+                delta3 = delta.reshape(-1, a, b)
+                coeffs = encode_chunks(delta3, d_a, d_b)  # [·, a·b]
+                i_s, v_s = topk_compress(coeffs, topk)    # [·, k]
+                est = sparse_decode_chunks(i_s, v_s, d_a, d_b)
+                nd = (delta3 - est).reshape(-1, a * b).astype(stage_dt)
+                return nd, i_s, v_s
+
+            d_state = state["delta"][key]
+            if n_seg > 1:
+                # unrolled slice loop, NOT lax.map: a stacked map operand
+                # forces a full-size layout copy; slices read straight
+                # from the source buffers. An optimization_barrier chains
+                # each segment on the previous one's output — without it
+                # XLA schedules the segments CONCURRENTLY and their temps
+                # coexist, defeating the whole memory bound.
+                seg = -(-n_chunks // n_seg)
+                parts = []
+                prev = None
+                for lo in range(0, n_chunks, seg):
+                    hi = min(lo + seg, n_chunks)
+                    d_seg = jax.lax.slice_in_dim(d_state, lo, hi, axis=0)
+                    g_seg = jax.lax.slice_in_dim(g_cat, lo, hi, axis=0)
+                    if prev is not None:
+                        d_seg, g_seg, _ = jax.lax.optimization_barrier(
+                            (d_seg, g_seg, prev))
+                    out = encode_one(d_seg, g_seg)
+                    parts.append(out)
+                    prev = out[0]
+                new_delta[key] = jnp.concatenate([p[0] for p in parts], 0)
+                idx = jnp.concatenate([p[1] for p in parts], 0)
+                val = jnp.concatenate([p[2] for p in parts], 0)
+            else:
+                new_delta[key], idx, val = encode_one(d_state, g_cat)
             k = idx.shape[-1]
-            # residual correction: subtract own transmitted estimate
-            # (reference demo.py:170-180). Own picks are distinct within a
-            # chunk (top-k), so mean == identity and the estimate decodes
-            # sparsely — no dense grid, no counts.
-            est = sparse_decode_chunks(idx, val, d_a, d_b)
-            new_delta[key] = delta - est
             # exchange: (val, idx-bitcast) packed into ONE f32 payload →
             # one all_gather per signature regardless of model depth
             payload = jnp.concatenate(
@@ -162,29 +236,51 @@ class DeMoStrategy(Strategy):
             # (cost ∝ chunk_elems, K-independent); past the crossover —
             # and past `mean_weights`' O(m²) mask — the dense route wins,
             # e.g. the 64-node configs.
-            if k_nodes * k <= 128:
-                w = mean_weights(all_idx, all_val)
-                decoded_chunks[key] = sparse_decode_chunks(all_idx, w,
-                                                           d_a, d_b)
+            def decode_one(ii, vv):
+                if k_nodes * k <= 128:
+                    w = mean_weights(ii, vv)
+                    dec = sparse_decode_chunks(ii, w, d_a, d_b)
+                else:
+                    dense = scatter_mean_decode(ii, vv, a * b)
+                    dec = decode_chunks(dense, d_a, d_b)
+                # only the sign survives (sign-SGD, phase 3): ±1/0 is
+                # exact in bf16 and halves the resident decode memory
+                return jnp.sign(dec).reshape(-1, a * b).astype(jnp.bfloat16)
+
+            if n_seg > 1:
+                dec_parts = []
+                prev = None
+                for lo in range(0, n_chunks, seg):
+                    hi = min(lo + seg, n_chunks)
+                    ii = jax.lax.slice_in_dim(all_idx, lo, hi, axis=0)
+                    vv = jax.lax.slice_in_dim(all_val, lo, hi, axis=0)
+                    if prev is not None:
+                        ii, vv, _ = jax.lax.optimization_barrier(
+                            (ii, vv, prev))
+                    prev = decode_one(ii, vv)
+                    dec_parts.append(prev)
+                decoded_chunks[key] = jnp.concatenate(dec_parts, 0)
             else:
-                dense = scatter_mean_decode(all_idx, all_val, a * b)
-                decoded_chunks[key] = decode_chunks(dense, d_a, d_b)
+                decoded_chunks[key] = decode_one(all_idx, all_val)
             comm_tx += float(idx.shape[0] * k * 8)  # int32 idx + f32 val
 
         # Phase 3 (local): sign-SGD with optional step-weight-decay
         # (reference demo.py:159-160, 206-209) — per leaf by necessity
         # (params live per leaf), one fused elementwise pass each.
+        # `decoded_chunks` already holds the sign (bf16 ±1/0, exact).
         new_params_leaves = []
         offsets = {key: 0 for key in new_delta}
         for p, codec in zip(p_leaves, codecs):
             key = f"{codec.a}x{codec.b}"
             off, n = offsets[key], codec.n_chunks
-            dec = codec.from_chunks(decoded_chunks[key][off:off + n])
+            sgn = codec.from_chunks(
+                decoded_chunks[key][off:off + n]
+                .reshape(n, codec.a, codec.b))
             offsets[key] = off + n
             new_p = p.reshape(codec.shape)
             if self.weight_decay:
                 new_p = new_p * (1.0 - lr * self.weight_decay)
-            new_p = new_p - lr * jnp.sign(dec)
+            new_p = new_p - lr * sgn.astype(jnp.float32)
             new_params_leaves.append(new_p.reshape(p.shape).astype(p.dtype))
 
         new_params = jax.tree.unflatten(treedef, new_params_leaves)
@@ -205,5 +301,8 @@ class DeMoStrategy(Strategy):
             "compression_topk": self.compression_topk,
             "compression_chunk": self.compression_chunk,
             "weight_decay": self.weight_decay,
+            "segment_bytes": self.segment_bytes,
+            "delta_dtype": str(jnp.dtype(self.delta_dtype))
+                           if self.delta_dtype else "float32",
         })
         return cfg

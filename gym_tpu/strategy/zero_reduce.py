@@ -12,11 +12,14 @@ O(model/K) — at GPT-2 base with AdamW that is ~1 GB of moments per node
 back; per-device, the whole K-node simulator's moment memory shrinks from
 K× model to 1× model.
 
-Collective shape: the canonical ZeRO-1 uses reduce-scatter + all-gather
-(same bytes as one all-reduce). ``lax.psum_scatter`` has no batching rule
-for the vmapped ``vnode`` axis, so this implementation averages with
-``pmean`` and slices — per-node comm is 2(K−1)/K·|g| + (K−1)/K·|θ|, i.e.
-~1.5× the canonical schedule; ``comm_bytes`` reports the actual schedule.
+Collective shape: on a physical node mesh (n_virt == 1, the benchmarked
+case) the canonical ZeRO-1 schedule runs — ``lax.psum_scatter`` of the
+gradient + ``all_gather`` of the updated slices, (K−1)/K·(|g| + |θ|)
+per-node bytes, the same total as one all-reduce. Under vnode folding
+(K > devices) ``psum_scatter`` has no batching rule, so the step falls
+back to ``pmean`` + slice — 2(K−1)/K·|g| + (K−1)/K·|θ|, ~1.5× the
+canonical bytes. Both schedules compute identical parameters
+(``tests/test_strategies.py``); ``comm_bytes`` reports whichever ran.
 
 Works with every ``OptimSpec`` optimizer: they are all elementwise, so a
 flat parameter slice is a valid optax pytree.
@@ -74,14 +77,30 @@ class ZeroReduceStrategy(Strategy):
         flat_g = jnp.pad(flat_g.astype(jnp.float32), (0, pad))
         flat_p_pad = jnp.pad(flat_p.astype(jnp.float32), (0, pad))
 
-        # average + clip on the full vector (identical semantics to
-        # SimpleReduce: reduce even at K=1, clip AFTER the mean)
-        flat_g = ctx.pmean(flat_g)
-        flat_g = self._maybe_clip(flat_g)
+        off = ctx.node_index() * shard
+        if len(ctx.axes) == 1 and k > 1:
+            # canonical ZeRO-1: reduce-scatter the gradient — each node
+            # receives only its summed 1/K chunk. Clip semantics identical
+            # to the fallback (clip AFTER the mean, by the GLOBAL norm):
+            # the full-vector norm is assembled from the chunk norms with
+            # one scalar psum.
+            g_my = ctx.reduce_scatter(flat_g) / k
+            if self.max_norm:
+                norm = jnp.sqrt(ctx.psum(jnp.sum(jnp.square(g_my))))
+                g_my = g_my * jnp.minimum(1.0, self.max_norm / (norm + 1e-6))
+            comm = ((k - 1) / k
+                    * (tree_bytes(grads) + tree_bytes(params)))
+        else:
+            # vnode fallback: average + clip on the full vector (identical
+            # semantics to SimpleReduce: reduce even at K=1, clip AFTER
+            # the mean), then slice
+            flat_g = ctx.pmean(flat_g)
+            flat_g = self._maybe_clip(flat_g)
+            g_my = lax.dynamic_slice(flat_g, (off,), (shard,))
+            comm = ((k - 1) / max(k, 1)
+                    * (2.0 * tree_bytes(grads) + tree_bytes(params)))
 
         # this node's 1/K slice: optimizer state exists ONLY for it
-        off = ctx.node_index() * shard
-        g_my = lax.dynamic_slice(flat_g, (off,), (shard,))
         p_my = lax.dynamic_slice(flat_p_pad, (off,), (shard,))
         updates, opt_state = self.tx.update(g_my, state["opt"], p_my)
         p_my = optax.apply_updates(p_my, updates)
@@ -90,9 +109,6 @@ class ZeroReduceStrategy(Strategy):
         new_params = jax.tree.map(
             lambda x, p: x.astype(p.dtype),
             unshard(ctx, p_my, flat_p.size, unravel), params)
-
-        comm = ((k - 1) / max(k, 1)
-                * (2.0 * tree_bytes(grads) + tree_bytes(params)))
         return (
             new_params,
             {"opt": opt_state},

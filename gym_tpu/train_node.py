@@ -182,6 +182,106 @@ def make_multi_train_step(loss_model: LossModel, strategy: Strategy,
     return node_multi
 
 
+def make_pipeline_init_fn(pipe_model, strategy: Strategy, example_micro,
+                          seed: int, ctx: AxisCtx = None,
+                          static_stage=None):
+    """Per-node init for the pipelined model (``parallel/pipeline_model``):
+    same seed ⇒ same full-model weights as a ``pp=1`` run, each device
+    keeping its own stage slice. ``static_stage`` pins the slice for
+    shape inference (``jax.eval_shape``) outside the mesh program."""
+    if ctx is not None:
+        strategy.bind_ctx(ctx)
+
+    def init_fn(node_index: jnp.ndarray) -> TrainState:
+        base = jax.random.PRNGKey(seed)
+        params, model_state = pipe_model.init(base, example_micro,
+                                              static_stage=static_stage)
+        return TrainState(
+            params=params,
+            model_state=model_state,
+            strategy_state=strategy.init(params),
+            step=jnp.zeros((), jnp.int32),
+            rng=jax.random.fold_in(base, node_index + 1),
+        )
+
+    return init_fn
+
+
+def make_pipeline_train_step(pipe_model, strategy: Strategy, ctx: AxisCtx,
+                             skip_nonfinite: bool = False):
+    """Pipelined ``node_step``: the grad-accum microbatches [n_micro, ...]
+    are consumed in ONE ``pipe_loss`` call — they are the GPipe schedule's
+    M — and the backward pass is autodiff of the schedule. Gradients of
+    stage params stay stage-local; gradients of the replicated "outer"
+    params (embeddings: stage 0; tied head: stage S−1) are combined with
+    one ``pp_psum``. Everything downstream (strategy collectives over the
+    node axes, metrics) is unchanged — pipeline composes with any
+    tree-mapped strategy."""
+
+    def node_step(state: TrainState, batch) -> Tuple[TrainState, Dict]:
+        step_rng = jax.random.fold_in(state.rng, state.step)
+
+        def loss_fn(params):
+            # the LOCAL masked loss: single-source gradient seed (see
+            # pipe_loss_local's docstring)
+            return pipe_model.pipe_loss_local(params, state.model_state,
+                                              batch, step_rng, True)
+
+        (loss_local, model_state), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        loss = jax.lax.psum(loss_local, ctx.pp_axes)  # replicated metric
+        grads = {"outer": ctx.pp_psum(grads["outer"]),
+                 "stages": grads["stages"]}
+
+        if skip_nonfinite:
+            ok = jnp.isfinite(loss)
+            for g in jax.tree.leaves(grads):
+                ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g)))
+            # one quarantine decision PER NODE: stage-local grads differ
+            # per pipe device, so a stage-local NaN must zero the grads on
+            # EVERY stage of that node — a split decision would desync the
+            # replicated outer params across the pipe group forever
+            if ctx.pp_axes:
+                ok = jax.lax.psum(ok.astype(jnp.float32),
+                                  ctx.pp_axes) >= float(ctx.pp)
+            grads = jax.tree.map(
+                lambda g: jnp.where(ok, g, jnp.zeros_like(g)), grads
+            )
+
+        params, sstate, metrics = strategy.step(
+            grads, state.params, state.strategy_state, state.step, ctx
+        )
+        new_state = state.replace(
+            params=params,
+            model_state=model_state,
+            strategy_state=sstate,
+            step=state.step + 1,
+        )
+        metrics = dict(metrics)
+        metrics["loss"] = loss
+        if skip_nonfinite:
+            metrics["nonfinite"] = 1.0 - ok.astype(jnp.float32)
+        return new_state, metrics
+
+    return node_step
+
+
+def make_pipeline_eval_step(pipe_model, ctx: AxisCtx):
+    """Pipelined local/global eval — the same observable pair as
+    ``make_eval_step``, with the forward pass through the schedule."""
+
+    def node_eval(state: TrainState, batch):
+        avg_params = ctx.pmean(state.params)
+        dummy_rng = jax.random.PRNGKey(0)
+        l_loc, _ = pipe_model.pipe_loss(
+            state.params, state.model_state, batch, dummy_rng, False)
+        l_glob, _ = pipe_model.pipe_loss(
+            avg_params, state.model_state, batch, dummy_rng, False)
+        return l_loc, l_glob
+
+    return node_eval
+
+
 def make_eval_step(loss_model: LossModel, ctx: AxisCtx):
     """Build ``node_eval(state, batch) -> (local_loss, global_loss)``.
 

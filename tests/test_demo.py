@@ -270,3 +270,67 @@ def test_demo_trains_tiny_net():
     first = res.history["train_loss"][0][1]
     last = np.mean([l for _, l in res.history["train_loss"][-5:]])
     assert last < first, (first, last)
+
+
+@pytest.mark.parametrize("n_nodes", [2, 8])
+def test_demo_segmented_pipeline_is_exact(n_nodes):
+    """`segment_bytes` bounds the encode/decode transient memory by
+    processing tile groups in unrolled, barrier-chained slice segments —
+    it must be a pure scheduling choice: forcing many tiny segments (with
+    a chunk count
+    that does NOT divide evenly, exercising the zero-padding) produces
+    bit-identical parameters (sign quantization absorbs float reassociation)
+    and delta state equal to float tolerance (XLA contracts the DCT einsums
+    in a shape-dependent order). n_nodes=8 also crosses the dense-decode
+    route (K·k > 128)."""
+    K = n_nodes
+    rng = np.random.default_rng(11)
+    w0 = {"w": np.repeat(rng.normal(size=(1, 24, 8)).astype(np.float32),
+                         K, axis=0),
+          "b": np.repeat(rng.normal(size=(1, 8)).astype(np.float32),
+                         K, axis=0)}
+    grads = {"w": rng.normal(size=(K, 24, 8)).astype(np.float32),
+             "b": rng.normal(size=(K, 8)).astype(np.float32)}
+
+    def run(segment_bytes):
+        strat = DeMoStrategy(optim_spec=OptimSpec("sgd", lr=0.1),
+                             compression_topk=32, compression_chunk=8,
+                             segment_bytes=segment_bytes)
+        rt, step_fn, params, state = make_harness(strat, K, w0)
+        for t in range(3):
+            params, state, _ = step_fn(params, state, grads, t)
+        return jax.device_get(params), jax.device_get(state)
+
+    p_one, s_one = run(0)            # unsegmented
+    p_seg, s_seg = run(2 * 8 * 8 * 4)  # 2 chunks/segment; 7 chunks total
+    jax.tree.map(np.testing.assert_array_equal, p_seg, p_one)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        s_seg, s_one)
+
+
+def test_demo_bf16_delta_trains():
+    """delta_dtype=bf16 halves the residual-state memory (the knob that
+    fits 8-node GPT-2-base DeMo on one chip). The encode still runs in
+    f32; the compressed channel (sign-SGD of top-k decode) absorbs the
+    storage rounding — training converges like the f32-delta run."""
+    from gym_tpu import Trainer
+    from test_trainer_e2e import TinyLossModel, blobs
+
+    def run(delta_dtype):
+        res = Trainer(TinyLossModel(), blobs(512)).fit(
+            strategy=DeMoStrategy(optim_spec=OptimSpec("sgd", lr=3e-3),
+                                  compression_topk=8,
+                                  delta_dtype=delta_dtype),
+            num_nodes=4, max_steps=30, batch_size=32, minibatch_size=32,
+            val_size=0, val_interval=0, show_progress=False,
+            log_dir="/tmp/gym_tpu_test_logs",
+        )
+        return [l for _, l in res.history["train_loss"]]
+
+    f32 = run(None)
+    bf16 = run(jnp.bfloat16)
+    assert np.mean(bf16[-5:]) < bf16[0]
+    # same trajectory within the sign-channel's discretization
+    np.testing.assert_allclose(np.mean(bf16[-5:]), np.mean(f32[-5:]),
+                               rtol=0.1)

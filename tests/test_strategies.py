@@ -16,13 +16,14 @@ from gym_tpu.strategy import (DiLoCoStrategy, FedAvgStrategy, OptimSpec,
                               SPARTAStrategy, ZeroReduceStrategy)
 
 
-def make_harness(strategy, num_nodes, params_np, max_steps=100):
+def make_harness(strategy, num_nodes, params_np, max_steps=100,
+                 devices=None):
     """Compile per-step strategy application over the node mesh.
 
     params_np: dict of [K, ...] arrays (per-node initial params).
     Returns (step_fn, params, state) with host-side step loop.
     """
-    rt = NodeRuntime.create(num_nodes)
+    rt = NodeRuntime.create(num_nodes, devices)
     strategy.finalize(max_steps)
     strategy.bind_ctx(rt.ctx)
 
@@ -240,6 +241,44 @@ def test_zero_reduce_matches_simple_reduce():
     moments = [x for x in jax.tree.leaves(s_zero["opt"]) if x.ndim == 2]
     assert moments and all(x.shape == (K, -(-26 // K)) for x in moments), \
         [x.shape for x in jax.tree.leaves(s_zero["opt"])]
+
+
+def test_zero_reduce_canonical_matches_vnode_schedule():
+    """On a physical node mesh ZeRO-1 runs the canonical reduce-scatter +
+    all-gather schedule; under vnode folding it falls back to pmean+slice.
+    Same K, same grads → identical parameters (incl. the distributed
+    global-norm clip), and comm_bytes reports each schedule's real cost
+    ((K−1)/K·(|g|+|θ|) vs (K−1)/K·(2|g|+|θ|))."""
+    K = 4
+    rng = np.random.default_rng(3)
+    w0 = {"w": np.repeat(rng.normal(size=(1, 7, 3)).astype(np.float32),
+                         K, axis=0),
+          "b": np.repeat(rng.normal(size=(1, 5)).astype(np.float32),
+                         K, axis=0)}
+
+    def run(n_devices):
+        strat = ZeroReduceStrategy(
+            optim_spec=OptimSpec("adamw", lr=1e-2), max_norm=1.0)
+        rt, step_fn, params, state = make_harness(
+            strat, K, w0, devices=jax.devices()[:n_devices])
+        assert (rt.n_virt == 1) == (n_devices == K)
+        rng_g = np.random.default_rng(4)
+        comm = None
+        for t in range(3):
+            g = {"w": rng_g.normal(size=(K, 7, 3)).astype(np.float32),
+                 "b": rng_g.normal(size=(K, 5)).astype(np.float32)}
+            params, state, m = step_fn(params, state, g, t)
+            comm = float(np.asarray(m["comm_bytes"]).ravel()[0])
+        return jax.device_get(params), comm
+
+    p_can, c_can = run(K)      # n_virt=1 → reduce-scatter
+    p_vn, c_vn = run(K // 2)   # n_virt=2 → pmean+slice fallback
+    for key in ("w", "b"):
+        np.testing.assert_allclose(p_can[key], p_vn[key],
+                                   atol=1e-6, rtol=1e-5)
+    bytes_gp = (7 * 3 + 5) * 4  # |g| = |θ| = 26 f32 leaves per node
+    np.testing.assert_allclose(c_can, 0.75 * 2 * bytes_gp)
+    np.testing.assert_allclose(c_vn, 0.75 * 3 * bytes_gp)
 
 
 def test_zero_reduce_requires_ctx():
