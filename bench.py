@@ -20,6 +20,10 @@ import time
 
 CPU_BASELINE_IT_S = 0.008  # measured on this host: `python bench.py --cpu`
 # (64-node nanoGPT DiLoCo on 8 virtual CPU devices: ~125 s/step)
+CPU_BASELINE_MEASURED_AT = "2026-07-29"  # provenance of the constant above
+# (VERDICT r2 weak #8: vs_baseline must not silently trust an undated
+# constant — the date is stamped into the JSON; re-measure with --cpu
+# and override via GYM_TPU_BENCH_BASELINE, which stamps "env-override")
 
 NUM_NODES = 64
 BLOCK_SIZE = 256
@@ -118,8 +122,10 @@ def main() -> None:
     it_s = timed_calls * spc / best_dt
     assert np.isfinite(loss), f"non-finite loss {loss}"
 
-    baseline = float(os.environ.get("GYM_TPU_BENCH_BASELINE",
-                                    CPU_BASELINE_IT_S))
+    baseline_env = os.environ.get("GYM_TPU_BENCH_BASELINE")
+    baseline = float(baseline_env) if baseline_env else CPU_BASELINE_IT_S
+    baseline_prov = ("env-override" if baseline_env
+                     else CPU_BASELINE_MEASURED_AT)
     # MFU of the whole 64-node workload (seqs/iter = nodes × per-node batch)
     mfu = node_mfu(cfg, state.params, NUM_NODES * BATCH_PER_NODE, 1.0 / it_s)
     result = {
@@ -127,6 +133,8 @@ def main() -> None:
         "value": round(it_s, 3),
         "unit": "it/s",
         "vs_baseline": round(it_s / baseline, 2),
+        "cpu_baseline_it_s": baseline,
+        "cpu_baseline_measured_at": baseline_prov,
         "mfu": round(mfu, 4),
         # timing method is part of the metric's identity: values up to
         # r2 were single-window; best-of-2 removes transport jitter and

@@ -59,7 +59,11 @@ def bench_kernel(T, impl, B=4, H=8, D=64, inner=10, iters=4):
         return f"{type(e).__name__}"
 
 
-def bench_ring(T, cp, B=1, H=4, D=32, iters=5):
+def bench_ring(T, cp, B=1, H=4, D=32, iters=5, inner=1):
+    """``inner`` > 1 chains ring calls inside ONE jit (fori_loop), so
+    per-dispatch transport latency (~100 ms on remote tunnels) amortizes
+    — required for honest chip timings; CPU-mesh runs are compute-bound
+    and fine at inner=1."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, PartitionSpec as P
@@ -75,23 +79,33 @@ def bench_ring(T, cp, B=1, H=4, D=32, iters=5):
     mesh = Mesh(np.array(devs[:cp]), ("seq",))
     spec = P(None, None, "seq", None)
     rng = np.random.default_rng(0)
+    dt_in = jnp.float32 if cp > 1 else jnp.bfloat16
     q, k, v = (
-        jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.float32)
+        jnp.asarray(rng.standard_normal((B, H, T, D)), dt_in)
         for _ in range(3)
     )
 
     def f(q, k, v):
         return ring_causal_attention(q, k, v, axis_name="seq")
 
-    g = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=(spec,) * 3,
-                              out_specs=spec))
+    # check_vma=False: the kernel-backed block path's pallas out_shapes
+    # carry no vma info (same setting as the NodeRuntime programs)
+    sm = jax.shard_map(f, mesh=mesh, in_specs=(spec,) * 3,
+                       out_specs=spec, check_vma=False)
+
+    @jax.jit
+    def g(q, k, v):
+        def body(_, x):
+            return sm(x, k, v)
+        out = jax.lax.fori_loop(0, inner, body, q)
+        return jnp.sum(out.astype(jnp.float32))
+
     try:
-        jax.block_until_ready(g(q, k, v))
+        float(g(q, k, v))  # compile + warm, fenced by the value fetch
         t0 = time.perf_counter()
         for _ in range(iters):
-            out = g(q, k, v)
-        float(jnp.sum(out[..., 0]))
-        dt = (time.perf_counter() - t0) / iters
+            acc = float(g(q, k, v))
+        dt = (time.perf_counter() - t0) / (iters * inner)
         return dt * 1000
     except Exception as e:
         return f"{type(e).__name__}"
@@ -99,7 +113,8 @@ def bench_ring(T, cp, B=1, H=4, D=32, iters=5):
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--mode", choices=["kernel", "ring"], default="kernel")
+    p.add_argument("--mode", choices=["kernel", "ring", "ring_chip"],
+                   default="kernel")
     p.add_argument("--device", default=None)
     args = p.parse_args()
     if args.device == "cpu":
@@ -108,16 +123,30 @@ def main():
 
     results = []
     if args.mode == "kernel":
-        for T in (512, 1024, 2048, 4096, 8192):
+        for T in (512, 1024, 2048, 4096, 8192, 16384, 32768):
             row = {"T": T}
             for impl in ("dense", "flash"):
                 row[impl] = bench_kernel(T, impl)
             results.append(row)
             print(json.dumps(row), flush=True)
+    elif args.mode == "ring_chip":
+        # the ring path on the real chip: a 1-wide ring routes through the
+        # tiled flash kernel (ring_attention.py n==1 dispatch), so the
+        # T=32k context runs the ring API at kernel speed on one device.
+        # dtype/inner recorded: these rows are NOT comparable to the f32
+        # inner=1 CPU-mesh ring rows.
+        for T in (8192, 16384, 32768):
+            ms = bench_ring(T, 1, B=1, H=8, D=64, inner=10)
+            row = {"T": T, "cp": 1, "ms": ms, "dtype": "bfloat16",
+                   "inner": 10}
+            results.append(row)
+            print(json.dumps(row), flush=True)
     else:
-        for T, cp in ((2048, 1), (2048, 8), (8192, 8), (16384, 8)):
+        for T, cp in ((2048, 1), (2048, 8), (8192, 8), (16384, 8),
+                      (32768, 8)):
             ms = bench_ring(T, cp)
-            row = {"T": T, "cp": cp, "ms": ms}
+            row = {"T": T, "cp": cp, "ms": ms, "dtype": "float32",
+                   "inner": 1}
             results.append(row)
             print(json.dumps(row), flush=True)
     os.makedirs("logs", exist_ok=True)

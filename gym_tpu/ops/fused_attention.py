@@ -191,6 +191,116 @@ def packed_supported(q, n_head: int) -> bool:
     return vmem <= 10 * 1024 * 1024
 
 
+# -- ring-attention block kernels: (o, lse) with differentiable lse --------
+#
+# The ring schedule (parallel/ring_attention.py) merges per-block results
+# in log-sum-exp space: out = Σ_b o_b · exp(lse_b − lse_tot). That makes
+# lse a *differentiable* output (∂lse/∂s = p), so these variants extend
+# the FA2 backward with the lse cotangent: ds = p·(dp − delta + dlse).
+# `causal=False` computes the full (un-masked) block — the shape of every
+# non-diagonal ring step.
+
+
+def _blk_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal):
+    q = q_ref[:, 0]
+    k = k_ref[:, 0]
+    v = v_ref[:, 0]
+    t = q.shape[1]
+    s = _bdot(q, k, (((2,), (2,)))) * scale
+    if causal:
+        s = jnp.where(_causal(t)[None], s, NEG)
+    m = jnp.max(s, axis=2, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=2, keepdims=True)
+    lse_ref[:, 0] = m + jnp.log(l)
+    o = _bdot((p / l).astype(v.dtype), v, ((2,), (1,)))
+    o_ref[:, 0] = o.astype(o_ref.dtype)
+
+
+def _blk_bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dlse_ref,
+                    dq_ref, dk_ref, dv_ref, *, scale, causal):
+    q = q_ref[:, 0]
+    k = k_ref[:, 0]
+    v = v_ref[:, 0]
+    o = o_ref[:, 0]
+    do = do_ref[:, 0]
+    lse = lse_ref[:, 0]
+    dlse = dlse_ref[:, 0]                         # [bc, T, 1] f32
+    t = q.shape[1]
+    s = _bdot(q, k, ((2,), (2,))) * scale
+    if causal:
+        s = jnp.where(_causal(t)[None], s, NEG)
+    p = jnp.exp(s - lse)
+    dv = _bdot(p.astype(do.dtype), do, ((1,), (1,)))
+    dp = _bdot(do, v, ((2,), (2,)))
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=2, keepdims=True)
+    ds = (p * (dp - delta + dlse) * scale).astype(q.dtype)
+    dq = _bdot(ds, k, ((2,), (1,)))
+    dk = _bdot(ds, q, ((1,), (1,)))
+    dq_ref[:, 0] = dq.astype(dq_ref.dtype)
+    dk_ref[:, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[:, 0] = dv.astype(dv_ref.dtype)
+
+
+def _blk_fwd(q, k, v, scale, causal):
+    b, h, t, d = q.shape
+    bc = _batch_chunk(b, t)
+    return pl.pallas_call(
+        functools.partial(_blk_fwd_kernel, scale=scale, causal=causal),
+        grid=(b // bc, h),
+        in_specs=[_bh_spec(bc, t, d)] * 3,
+        out_specs=[_bh_spec(bc, t, d), _lse_spec(bc, t)],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, t, 1), jnp.float32),
+        ],
+        interpret=INTERPRET,
+    )(q, k, v)
+
+
+def _blk_bwd(q, k, v, o, do, lse, dlse, scale, causal):
+    b, h, t, d = q.shape
+    bc = _batch_chunk(b, t)
+    return pl.pallas_call(
+        functools.partial(_blk_bwd_kernel, scale=scale, causal=causal),
+        grid=(b // bc, h),
+        in_specs=[_bh_spec(bc, t, d)] * 5 + [_lse_spec(bc, t)] * 2,
+        out_specs=[_bh_spec(bc, t, d)] * 3,
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)] * 3,
+        interpret=INTERPRET,
+    )(q, k, v, o, do, lse, dlse)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_block_attention(q, k, v, causal, scale=None):
+    """One attention block for the ring schedule: returns ``(o, lse)``
+    with o normalized within the block and lse = logsumexp of the scores
+    ([B, H, T, 1] f32). Both outputs are differentiable — the lse
+    cotangent from the caller's log-space merge flows into ds. T ≤ 1024
+    (whole-block kernel), no dropout."""
+    scale = scale or 1.0 / math.sqrt(q.shape[-1])
+    return _blk_fwd(q, k, v, scale, causal)
+
+
+def _vjp_fwd_blk(q, k, v, causal, scale):
+    scale = scale or 1.0 / math.sqrt(q.shape[-1])
+    o, lse = _blk_fwd(q, k, v, scale, causal)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _vjp_bwd_blk(causal, scale, res, cts):
+    q, k, v, o, lse = res
+    do, dlse = cts
+    scale = scale or 1.0 / math.sqrt(q.shape[-1])
+    dq, dk, dv = _blk_bwd(q, k, v, o, do.astype(q.dtype),
+                          lse, dlse.astype(jnp.float32), scale, causal)
+    return dq, dk, dv
+
+
+fused_block_attention.defvjp(_vjp_fwd_blk, _vjp_bwd_blk)
+
+
 # -- packed layout: [B, T, C] with C = H·D -------------------------------
 #
 # The standard [B, H, T, D] layout costs two transposes per attention call

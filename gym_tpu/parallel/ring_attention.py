@@ -55,6 +55,55 @@ def _block_attend(q, k, v, mask, scale, dropout_rate=0.0, dropout_rng=None):
     return o.astype(jnp.float32), m_safe, l
 
 
+def _kernel_blocks_ok(q: jnp.ndarray) -> bool:
+    """Ring blocks can ride the fused Pallas kernel when the local chunk
+    fits its whole-block VMEM budget (Tl ≤ 1024, 128-tiled) on a TPU (or
+    under the Pallas interpreter for CPU tests)."""
+    from ..ops import fused_attention
+    from ..ops.flash_attention import _on_tpu
+    tl = q.shape[-2]
+    return ((fused_attention.INTERPRET or _on_tpu())
+            and tl % 128 == 0 and tl <= 1024)
+
+
+def _ring_kernel_blocks(q, k, v, axis_name: str) -> jnp.ndarray:
+    """Ring schedule with Pallas-fused blocks (VERDICT r2 weak/next #8:
+    the dense ``_block_attend`` materializes a [Tl, Tl] f32 logits block
+    in XLA per ring step). Step 0 is the static diagonal (causal kernel);
+    every later step is a FULL block (non-causal kernel) gated by
+    ``src < my`` — later chunks are entirely masked, so their merge
+    weight is zeroed instead of their scores. Blocks merge in
+    log-sum-exp space; the kernels' lse output is differentiable
+    (``ops.fused_attention.fused_block_attention``), so autodiff of this
+    merge is the exact ring backward."""
+    from ..ops.fused_attention import fused_block_attention
+
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    o0, lse0 = fused_block_attention(q, k, v, True)
+    kc = lax.ppermute(k, axis_name, perm)
+    vc = lax.ppermute(v, axis_name, perm)
+
+    def ring_step(carry, r):
+        o_acc, lse_acc, kc, vc = carry
+        src = (my - r) % n
+        o_b, lse_b = fused_block_attention(q, kc, vc, False)
+        lse_b = jnp.where(src < my, lse_b, -1e30)
+        lse_new = jnp.logaddexp(lse_acc, lse_b)
+        o_acc = (o_acc * jnp.exp(lse_acc - lse_new)
+                 + o_b.astype(jnp.float32) * jnp.exp(lse_b - lse_new))
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (o_acc, lse_new, kc, vc), None
+
+    (o, _, _, _), _ = lax.scan(
+        ring_step, (o0.astype(jnp.float32), lse0, kc, vc),
+        jnp.arange(1, n))
+    return o.astype(q.dtype)
+
+
 def ring_causal_attention(
     q: jnp.ndarray,  # [B, H, Tl, D] — local sequence chunk
     k: jnp.ndarray,
@@ -71,8 +120,21 @@ def ring_causal_attention(
     around the ring; an online softmax merges each incoming block, so the
     result is bitwise-equivalent math to dense causal attention over the
     full sequence (up to fp reassociation).
+
+    Dispatch: a 1-wide ring is local causal attention and routes through
+    the flash dispatcher (so cp=1 long context rides the tiled kernel);
+    wider rings use Pallas-fused blocks when the chunk is kernel-eligible
+    (``_kernel_blocks_ok``), else the dense XLA block path below.
     """
     n = lax.axis_size(axis_name)
+    drop = dropout_rate > 0.0 and not deterministic
+    if n == 1:
+        from ..ops.flash_attention import flash_causal_attention
+        return flash_causal_attention(
+            q, k, v, dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+            deterministic=deterministic)
+    if not drop and _kernel_blocks_ok(q):
+        return _ring_kernel_blocks(q, k, v, axis_name)
     my = lax.axis_index(axis_name)
     tl = q.shape[-2]
     scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))

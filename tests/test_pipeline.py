@@ -254,8 +254,14 @@ def test_fit_pp_trains_on_real_data():
 
 def test_fit_pp_rejects_flat_layout_strategies():
     import pytest
+    from gym_tpu.strategy.diloco import DiLoCoStrategy
     from gym_tpu.strategy.optim import OptimSpec
     from gym_tpu.strategy.zero_reduce import ZeroReduceStrategy
 
     with pytest.raises(ValueError, match="tree-mapped"):
         _pp_fit(pp=2, strategy=ZeroReduceStrategy(OptimSpec("adamw")))
+    # DiLoCo's sharded outer master is a flat per-node vector too: under
+    # pp it would slice each device's own stage view — refuse it
+    with pytest.raises(ValueError, match="tree-mapped"):
+        _pp_fit(pp=2, strategy=DiLoCoStrategy(OptimSpec("adamw"), H=2,
+                                              shard_outer=True))
