@@ -59,7 +59,7 @@ def bench_kernel(T, impl, B=4, H=8, D=64, inner=10, iters=4):
         return f"{type(e).__name__}"
 
 
-def bench_ring(T, cp, B=1, H=4, D=32, iters=5, inner=1):
+def bench_ring(T, cp, B=1, H=4, D=32, iters=5, inner=1, dtype="float32"):
     """``inner`` > 1 chains ring calls inside ONE jit (fori_loop), so
     per-dispatch transport latency (~100 ms on remote tunnels) amortizes
     — required for honest chip timings; CPU-mesh runs are compute-bound
@@ -79,9 +79,8 @@ def bench_ring(T, cp, B=1, H=4, D=32, iters=5, inner=1):
     mesh = Mesh(np.array(devs[:cp]), ("seq",))
     spec = P(None, None, "seq", None)
     rng = np.random.default_rng(0)
-    dt_in = jnp.float32 if cp > 1 else jnp.bfloat16
     q, k, v = (
-        jnp.asarray(rng.standard_normal((B, H, T, D)), dt_in)
+        jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.dtype(dtype))
         for _ in range(3)
     )
 
@@ -136,7 +135,8 @@ def main():
         # dtype/inner recorded: these rows are NOT comparable to the f32
         # inner=1 CPU-mesh ring rows.
         for T in (8192, 16384, 32768):
-            ms = bench_ring(T, 1, B=1, H=8, D=64, inner=10)
+            ms = bench_ring(T, 1, B=1, H=8, D=64, inner=10,
+                            dtype="bfloat16")
             row = {"T": T, "cp": 1, "ms": ms, "dtype": "bfloat16",
                    "inner": 10}
             results.append(row)

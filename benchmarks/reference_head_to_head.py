@@ -255,7 +255,8 @@ def torch_eval_loss_gpt(model, ds, block):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--steps", type=int, default=200)
+    # defaults reproduce BENCHMARKS.md "Head-to-head" exactly
+    ap.add_argument("--steps", type=int, default=150)
     ap.add_argument("--gpt_steps", type=int, default=100)
     ap.add_argument("--only", default=None)
     ap.add_argument("--out", default="logs/head_to_head.json")

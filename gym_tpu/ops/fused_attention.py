@@ -57,53 +57,6 @@ def _bdot(a, b, dims, prec=jnp.float32):
                                preferred_element_type=prec)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale):
-    # dots take the inputs' native dtype (bf16 under autocast) and
-    # accumulate f32 via preferred_element_type — bit-identical to
-    # upcasting first (bf16×bf16 products are exact in f32) but runs the
-    # MXU at bf16 rate instead of f32 rate.
-    q = q_ref[:, 0]                              # [bc, T, D]
-    k = k_ref[:, 0]
-    v = v_ref[:, 0]
-    t = q.shape[1]
-    s = _bdot(q, k, (((2,), (2,)))) * scale      # [bc, T, T] f32
-    s = jnp.where(_causal(t)[None], s, NEG)
-    m = jnp.max(s, axis=2, keepdims=True)
-    p = jnp.exp(s - m)
-    l = jnp.sum(p, axis=2, keepdims=True)
-    lse_ref[:, 0] = m + jnp.log(l)               # [bc, T, 1]
-    o = _bdot((p / l).astype(v.dtype), v, ((2,), (1,)))
-    o_ref[:, 0] = o.astype(o_ref.dtype)
-
-
-def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
-                dq_ref, dk_ref, dv_ref, *, scale):
-    # all five dots run at the inputs' native dtype (f32 accumulate);
-    # the recomputed probs p and the score gradient ds are cast back to
-    # that dtype before their dots — the flash-attention-2 convention
-    # (same precision class as the forward's (p/l).astype(v.dtype)).
-    q = q_ref[:, 0]
-    k = k_ref[:, 0]
-    v = v_ref[:, 0]
-    o = o_ref[:, 0]
-    do = do_ref[:, 0]
-    lse = lse_ref[:, 0]                           # [bc, T, 1]
-    t = q.shape[1]
-    s = _bdot(q, k, ((2,), (2,))) * scale
-    s = jnp.where(_causal(t)[None], s, NEG)
-    p = jnp.exp(s - lse)                          # normalized probs, f32
-    dv = _bdot(p.astype(do.dtype), do, ((1,), (1,)))   # [bc, T, D]
-    dp = _bdot(do, v, ((2,), (2,)))               # [bc, T, T] f32
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=2, keepdims=True)
-    ds = (p * (dp - delta) * scale).astype(q.dtype)
-    dq = _bdot(ds, k, ((2,), (1,)))
-    dk = _bdot(ds, q, ((1,), (1,)))
-    dq_ref[:, 0] = dq.astype(dq_ref.dtype)
-    dk_ref[:, 0] = dk.astype(dk_ref.dtype)
-    dv_ref[:, 0] = dv.astype(dv_ref.dtype)
-
-
 def _bh_spec(bc, t, d):
     return pl.BlockSpec((bc, 1, t, d), lambda i, h: (i, h, 0, 0),
                         memory_space=pltpu.VMEM)
@@ -115,55 +68,27 @@ def _lse_spec(bc, t):
                         memory_space=pltpu.VMEM)
 
 
-def _fwd(q, k, v, scale):
-    b, h, t, d = q.shape
-    bc = _batch_chunk(b, t)
-    o, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale),
-        grid=(b // bc, h),
-        in_specs=[_bh_spec(bc, t, d)] * 3,
-        out_specs=[_bh_spec(bc, t, d), _lse_spec(bc, t)],
-        out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((b, h, t, 1), jnp.float32),
-        ],
-        interpret=INTERPRET,
-    )(q, k, v)
-    return o, lse
-
-
-def _bwd(q, k, v, o, do, lse, scale):
-    b, h, t, d = q.shape
-    bc = _batch_chunk(b, t)
-    return pl.pallas_call(
-        functools.partial(_bwd_kernel, scale=scale),
-        grid=(b // bc, h),
-        in_specs=[_bh_spec(bc, t, d)] * 5 + [_lse_spec(bc, t)],
-        out_specs=[_bh_spec(bc, t, d)] * 3,
-        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)] * 3,
-        interpret=INTERPRET,
-    )(q, k, v, o, do, lse)
-
-
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def fused_causal_attention(q, k, v, scale=None):
     """softmax(mask(QKᵀ·scale))·V, fully fused on-chip. [B, H, T, D],
-    T ≤ 1024 (score block must fit VMEM), no dropout."""
+    T ≤ 1024 (score block must fit VMEM), no dropout. The whole-context
+    causal case of the block kernels below (dlse = 0)."""
     scale = scale or 1.0 / math.sqrt(q.shape[-1])
-    o, _ = _fwd(q, k, v, scale)
+    o, _ = _blk_fwd(q, k, v, scale, True)
     return o
 
 
 def _vjp_fwd(q, k, v, scale):
     scale = scale or 1.0 / math.sqrt(q.shape[-1])
-    o, lse = _fwd(q, k, v, scale)
+    o, lse = _blk_fwd(q, k, v, scale, True)
     return o, (q, k, v, o, lse)
 
 
 def _vjp_bwd(scale, res, do):
     q, k, v, o, lse = res
     scale = scale or 1.0 / math.sqrt(q.shape[-1])
-    dq, dk, dv = _bwd(q, k, v, o, do, lse, scale)
+    dq, dk, dv = _blk_bwd(q, k, v, o, do, lse, jnp.zeros_like(lse),
+                          scale, True)
     return dq, dk, dv
 
 
@@ -191,14 +116,22 @@ def packed_supported(q, n_head: int) -> bool:
     return vmem <= 10 * 1024 * 1024
 
 
-# -- ring-attention block kernels: (o, lse) with differentiable lse --------
+# -- block kernels: (o, lse) with differentiable lse ----------------------
 #
-# The ring schedule (parallel/ring_attention.py) merges per-block results
-# in log-sum-exp space: out = Σ_b o_b · exp(lse_b − lse_tot). That makes
-# lse a *differentiable* output (∂lse/∂s = p), so these variants extend
-# the FA2 backward with the lse cotangent: ds = p·(dp − delta + dlse).
-# `causal=False` computes the full (un-masked) block — the shape of every
-# non-diagonal ring step.
+# The ONE implementation of the FA2 math here: `fused_causal_attention`
+# above is the causal whole-context case (dlse = 0), and the ring
+# schedule (parallel/ring_attention.py) uses both variants per block,
+# merging results in log-sum-exp space: out = Σ_b o_b · exp(lse_b −
+# lse_tot). That makes lse a *differentiable* output (∂lse/∂s = p), so
+# the backward extends FA2 with the lse cotangent:
+# ds = p·(dp − delta + dlse). `causal=False` computes the full
+# (un-masked) block — the shape of every non-diagonal ring step.
+#
+# Dots take the inputs' native dtype (bf16 under autocast) and
+# accumulate f32 via preferred_element_type — bit-identical to upcasting
+# first (bf16×bf16 products are exact in f32) but runs the MXU at bf16
+# rate; the recomputed probs p and score gradient ds are cast back to
+# that dtype before their dots (the FA2 precision convention).
 
 
 def _blk_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal):

@@ -61,9 +61,9 @@ def _kernel_blocks_ok(q: jnp.ndarray) -> bool:
     under the Pallas interpreter for CPU tests)."""
     from ..ops import fused_attention
     from ..ops.flash_attention import _on_tpu
-    tl = q.shape[-2]
+    tl, d = q.shape[-2], q.shape[-1]
     return ((fused_attention.INTERPRET or _on_tpu())
-            and tl % 128 == 0 and tl <= 1024)
+            and tl % 128 == 0 and tl <= 1024 and d <= 256)
 
 
 def _ring_kernel_blocks(q, k, v, axis_name: str) -> jnp.ndarray:

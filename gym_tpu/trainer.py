@@ -495,22 +495,21 @@ class Trainer:
             s = min(steps_per_call, max_steps - step_idx)
             if s < steps_per_call or multi_step is None:
                 s = 1  # remainder runs on the single-step program
-            # eval at dispatch boundaries (with steps_per_call > 1 the
-            # boundary is quantized to the call that contains it)
-            eval_due = bool(val_interval) and (
-                step_idx % val_interval == 0
-                or (s > 1 and (step_idx % val_interval) + s > val_interval)
-            )
-            if eval_due:
+            # interval firings happen at dispatch boundaries (with
+            # steps_per_call > 1 the boundary is quantized to the call
+            # that contains it)
+            def due(interval):
+                return bool(interval) and (
+                    step_idx % interval == 0
+                    or (s > 1 and (step_idx % interval) + s > interval)
+                )
+
+            if due(val_interval):
                 if pending is not None:
                     drain(pending)
                     pending = None
                 run_eval()
-            if correlation_interval and (
-                step_idx % correlation_interval == 0
-                or (s > 1 and (step_idx % correlation_interval) + s
-                    > correlation_interval)
-            ):
+            if correlation_interval and due(correlation_interval):
                 log_correlation()
             if s > 1:
                 stacked = [train_iter.next_batch(n_micro, minibatch_size)
