@@ -158,7 +158,7 @@ def test_pipeline_gpt_trunk_matches_plain_forward():
 
 
 def _pp_fit(pp, num_nodes=2, n_layer=4, max_steps=6, dataset=None,
-            H=3, lr=1e-3, strategy=None):
+            H=3, lr=1e-3, strategy=None, **fit_kwargs):
     from gym_tpu.data.gpt_datasets import ContiguousGPTTrainDataset
     from gym_tpu.models.nanogpt import GPT, GPTConfig
     from gym_tpu.strategy.diloco import DiLoCoStrategy
@@ -183,7 +183,7 @@ def _pp_fit(pp, num_nodes=2, n_layer=4, max_steps=6, dataset=None,
         strategy=strategy or DiLoCoStrategy(OptimSpec("adamw", lr=lr), H=H),
         max_steps=max_steps, batch_size=8, minibatch_size=2, val_size=16,
         val_interval=3, pp=pp, show_progress=False,
-        log_dir="/tmp/gym_tpu_test_logs",
+        log_dir="/tmp/gym_tpu_test_logs", **fit_kwargs,
     )
 
 
@@ -265,3 +265,30 @@ def test_fit_pp_rejects_flat_layout_strategies():
     with pytest.raises(ValueError, match="tree-mapped"):
         _pp_fit(pp=2, strategy=DiLoCoStrategy(OptimSpec("adamw"), H=2,
                                               shard_outer=True))
+
+
+def test_fit_pp_multi_step_dispatch_and_autocast():
+    """pp composes with the multi-step dispatch (lax.scan of the
+    pipelined step) and with bf16 autocast: same trajectory as the
+    single-dispatch f32 run at matching semantics, and the autocast run
+    trains (finite, falling)."""
+    from gym_tpu.strategy.optim import OptimSpec
+    from gym_tpu.strategy.simple_reduce import SimpleReduceStrategy
+
+    def run(steps_per_call, autocast):
+        return _pp_fit(
+            pp=2,
+            strategy=SimpleReduceStrategy(OptimSpec("adamw", lr=1e-3)),
+            steps_per_call=steps_per_call, autocast=autocast)
+
+    with jax.default_matmul_precision("highest"):
+        r1 = run(1, False)
+        r3 = run(3, False)
+    a = [l for _, l in r1.history["train_loss"]]
+    b = [l for _, l in r3.history["train_loss"]]
+    np.testing.assert_allclose(b, a, rtol=2e-4, atol=1e-5)
+
+    rb = run(3, True)  # bf16 compute path through the pipelined model
+    lb = [l for _, l in rb.history["train_loss"]]
+    assert np.all(np.isfinite(lb)) and lb[-1] < lb[0] + 0.1
+    assert all(np.isfinite(v) for _, v in rb.history["global_loss"])
