@@ -288,7 +288,12 @@ def test_fit_pp_multi_step_dispatch_and_autocast():
     b = [l for _, l in r3.history["train_loss"]]
     np.testing.assert_allclose(b, a, rtol=2e-4, atol=1e-5)
 
-    rb = run(3, True)  # bf16 compute path through the pipelined model
+    # bf16 compute path through the pipelined model: a longer horizon so
+    # "falling" is assertable above per-step noise
+    rb = _pp_fit(pp=2, strategy=SimpleReduceStrategy(
+        OptimSpec("adamw", lr=3e-3)), max_steps=15, steps_per_call=3,
+        autocast=True)
     lb = [l for _, l in rb.history["train_loss"]]
-    assert np.all(np.isfinite(lb)) and lb[-1] < lb[0] + 0.1
+    assert np.all(np.isfinite(lb))
+    assert np.mean(lb[-3:]) < np.mean(lb[:3])
     assert all(np.isfinite(v) for _, v in rb.history["global_loss"])
