@@ -213,3 +213,80 @@ def test_skip_nonfinite_surfaces_in_fit_history():
     assert len(res.history["nonfinite"]) > 0
     for leaf in jax.tree.leaves(res.params):
         assert np.all(np.isfinite(leaf))
+
+
+def test_skip_nonfinite_quarantine_under_pipeline():
+    """skip_nonfinite under pipeline parallelism: poisoning ONE stage's
+    params of ONE node must (a) flag exactly that node as nonfinite and
+    (b) zero that node's whole gradient so the healthy node's update
+    stays finite and unpoisoned through the collective mean. (The NaN
+    propagates through the schedule, so every stage of the sick node
+    agrees; the cross-stage pp_psum agreement in
+    make_pipeline_train_step is defense-in-depth for grads-only NaNs —
+    it executes here but both stages already vote the same way.)"""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from gym_tpu.models.nanogpt import GPT, GPTConfig
+    from gym_tpu.parallel.axis import NODE_AXIS
+    from gym_tpu.parallel.mesh import NodeRuntime
+    from gym_tpu.parallel.pipeline_model import (PipelinedGPTLossModel,
+                                                 pipeline_state_specs)
+    from gym_tpu.strategy.optim import OptimSpec
+    from gym_tpu.strategy.simple_reduce import SimpleReduceStrategy
+    from gym_tpu.train_node import (make_pipeline_init_fn,
+                                    make_pipeline_train_step)
+
+    pp, num_nodes = 2, 2
+    runtime = NodeRuntime.create(num_nodes, jax.devices()[:num_nodes * pp],
+                                 pp=pp)
+    cfg = GPTConfig(block_size=16, vocab_size=32, n_layer=2, n_head=2,
+                    n_embd=16, dropout=0.0)
+    pipe_model = PipelinedGPTLossModel(cfg, pp)
+    strat = SimpleReduceStrategy(OptimSpec("sgd", lr=0.1))
+    strat.finalize(4)
+
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, 32, (num_nodes, 2, 2, 16), dtype=np.int64)
+    batch = runtime.shard_batch((idx, np.roll(idx, -1, -1)))
+    example = (idx[0, 0], idx[0, 0])
+
+    init_fn = make_pipeline_init_fn(pipe_model, strat, example, seed=0,
+                                    ctx=runtime.ctx)
+    shape_fn = make_pipeline_init_fn(pipe_model, strat, example, seed=0,
+                                     ctx=runtime.ctx, static_stage=0)
+    specs = pipeline_state_specs(
+        jax.eval_shape(shape_fn, jax.ShapeDtypeStruct((), jnp.int32)))
+    state = runtime.init_state(init_fn, specs)
+    step = runtime.compile(
+        make_pipeline_train_step(pipe_model, strat, runtime.ctx,
+                                 skip_nonfinite=True),
+        in_specs=(specs, P(NODE_AXIS)), out_specs=(specs, P(NODE_AXIS)))
+
+    # poison node 0's stage-stacked weights (hits ONE stage per device;
+    # the node's loss and grads go non-finite)
+    def poison(x):
+        x = np.array(x)  # writable copy
+        x[0, 0] = np.nan  # node 0, stage 0 only (spreads via the schedule)
+        return jnp.asarray(x)
+
+    stages = jax.tree.map(poison, jax.device_get(state.params["stages"]))
+    state = state.replace(params={**state.params, "stages": stages})
+    healthy_before = jax.tree.map(
+        lambda x: np.asarray(x)[1], jax.device_get(state.params["outer"]))
+
+    state, metrics = step(state, batch)
+    nf = np.asarray(metrics["nonfinite"])
+    assert nf.tolist() == [1.0, 0.0], nf
+    # the healthy node's loss is finite and its params moved
+    assert np.isfinite(np.asarray(metrics["loss"])[1])
+    healthy_after = jax.tree.map(
+        lambda x: np.asarray(x)[1], jax.device_get(state.params["outer"]))
+    # the poisoned node's zeroed grads must NOT leak NaN through the
+    # collective mean: the healthy node's params stay finite AND move
+    for leaf in jax.tree.leaves(healthy_after):
+        assert np.all(np.isfinite(leaf))
+    moved = any(
+        not np.allclose(a, b) for a, b in
+        zip(jax.tree.leaves(healthy_before), jax.tree.leaves(healthy_after)))
+    assert moved
