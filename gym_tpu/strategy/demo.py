@@ -38,6 +38,36 @@ from .base import PyTree, Strategy
 from .optim import OptimSpec, ensure_optim_spec
 
 
+def _segmented(fn, n_chunks: int, n_seg: int, *arrays):
+    """Apply ``fn(*array_slices) -> array | tuple`` over ``n_seg`` row
+    segments of ``arrays`` and concatenate each output position (a bare
+    array in → a bare array out, matching the unsegmented call).
+
+    Unrolled slice loop, NOT ``lax.map``: a stacked map operand forces a
+    full-size layout copy; slices read straight from the source buffers.
+    An ``optimization_barrier`` chains each segment on the previous one's
+    first output — without it XLA schedules the segments CONCURRENTLY and
+    their temporaries coexist, defeating the whole memory bound."""
+    if n_seg <= 1:
+        return fn(*arrays)
+    seg = -(-n_chunks // n_seg)
+    parts = []
+    prev = None
+    was_tuple = True
+    for lo in range(0, n_chunks, seg):
+        hi = min(lo + seg, n_chunks)
+        sl = [jax.lax.slice_in_dim(x, lo, hi, axis=0) for x in arrays]
+        if prev is not None:
+            *sl, _ = jax.lax.optimization_barrier((*sl, prev))
+        out = fn(*sl)
+        was_tuple = isinstance(out, tuple)
+        parts.append(out if was_tuple else (out,))
+        prev = parts[-1][0]
+    cat = tuple(jnp.concatenate([p[i] for p in parts], 0)
+                for i in range(len(parts[0])))
+    return cat if was_tuple else cat[0]
+
+
 class DeMoStrategy(Strategy):
     """Strategy whose optimizer IS the DeMo fused optimizer
     (reference ``demo.py:8-53``: compression knobs forwarded, lr from
@@ -188,32 +218,8 @@ class DeMoStrategy(Strategy):
                 nd = (delta3 - est).reshape(-1, a * b).astype(stage_dt)
                 return nd, i_s, v_s
 
-            d_state = state["delta"][key]
-            if n_seg > 1:
-                # unrolled slice loop, NOT lax.map: a stacked map operand
-                # forces a full-size layout copy; slices read straight
-                # from the source buffers. An optimization_barrier chains
-                # each segment on the previous one's output — without it
-                # XLA schedules the segments CONCURRENTLY and their temps
-                # coexist, defeating the whole memory bound.
-                seg = -(-n_chunks // n_seg)
-                parts = []
-                prev = None
-                for lo in range(0, n_chunks, seg):
-                    hi = min(lo + seg, n_chunks)
-                    d_seg = jax.lax.slice_in_dim(d_state, lo, hi, axis=0)
-                    g_seg = jax.lax.slice_in_dim(g_cat, lo, hi, axis=0)
-                    if prev is not None:
-                        d_seg, g_seg, _ = jax.lax.optimization_barrier(
-                            (d_seg, g_seg, prev))
-                    out = encode_one(d_seg, g_seg)
-                    parts.append(out)
-                    prev = out[0]
-                new_delta[key] = jnp.concatenate([p[0] for p in parts], 0)
-                idx = jnp.concatenate([p[1] for p in parts], 0)
-                val = jnp.concatenate([p[2] for p in parts], 0)
-            else:
-                new_delta[key], idx, val = encode_one(d_state, g_cat)
+            new_delta[key], idx, val = _segmented(
+                encode_one, n_chunks, n_seg, state["delta"][key], g_cat)
             k = idx.shape[-1]
             # exchange: (val, idx-bitcast) packed into ONE f32 payload →
             # one all_gather per signature regardless of model depth
@@ -247,21 +253,8 @@ class DeMoStrategy(Strategy):
                 # exact in bf16 and halves the resident decode memory
                 return jnp.sign(dec).reshape(-1, a * b).astype(jnp.bfloat16)
 
-            if n_seg > 1:
-                dec_parts = []
-                prev = None
-                for lo in range(0, n_chunks, seg):
-                    hi = min(lo + seg, n_chunks)
-                    ii = jax.lax.slice_in_dim(all_idx, lo, hi, axis=0)
-                    vv = jax.lax.slice_in_dim(all_val, lo, hi, axis=0)
-                    if prev is not None:
-                        ii, vv, _ = jax.lax.optimization_barrier(
-                            (ii, vv, prev))
-                    prev = decode_one(ii, vv)
-                    dec_parts.append(prev)
-                decoded_chunks[key] = jnp.concatenate(dec_parts, 0)
-            else:
-                decoded_chunks[key] = decode_one(all_idx, all_val)
+            decoded_chunks[key] = _segmented(
+                decode_one, n_chunks, n_seg, all_idx, all_val)
             comm_tx += float(idx.shape[0] * k * 8)  # int32 idx + f32 val
 
         # Phase 3 (local): sign-SGD with optional step-weight-decay

@@ -67,6 +67,16 @@ def _model_config(module) -> Dict[str, Any]:
     return out
 
 
+def _due(interval, step_idx: int, s: int) -> bool:
+    """Does a per-``interval`` firing fall inside the next ``s``-step
+    dispatch starting at ``step_idx``? (With steps_per_call > 1 the
+    boundary is quantized to the call that contains it.)"""
+    return bool(interval) and (
+        step_idx % interval == 0
+        or (s > 1 and (step_idx % interval) + s > interval)
+    )
+
+
 def _replica_correlation(params) -> float:
     """Mean pairwise Pearson correlation of the K flattened per-node
     parameter vectors (reference observable semantics: np.corrcoef over
@@ -498,18 +508,12 @@ class Trainer:
             # interval firings happen at dispatch boundaries (with
             # steps_per_call > 1 the boundary is quantized to the call
             # that contains it)
-            def due(interval):
-                return bool(interval) and (
-                    step_idx % interval == 0
-                    or (s > 1 and (step_idx % interval) + s > interval)
-                )
-
-            if due(val_interval):
+            if _due(val_interval, step_idx, s):
                 if pending is not None:
                     drain(pending)
                     pending = None
                 run_eval()
-            if correlation_interval and due(correlation_interval):
+            if _due(correlation_interval, step_idx, s):
                 log_correlation()
             if s > 1:
                 stacked = [train_iter.next_batch(n_micro, minibatch_size)

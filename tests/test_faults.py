@@ -224,10 +224,9 @@ def test_skip_nonfinite_quarantine_under_pipeline():
     agrees; the cross-stage pp_psum agreement in
     make_pipeline_train_step is defense-in-depth for grads-only NaNs —
     it executes here but both stages already vote the same way.)"""
-    import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
-    from gym_tpu.models.nanogpt import GPT, GPTConfig
+    from gym_tpu.models.nanogpt import GPTConfig
     from gym_tpu.parallel.axis import NODE_AXIS
     from gym_tpu.parallel.mesh import NodeRuntime
     from gym_tpu.parallel.pipeline_model import (PipelinedGPTLossModel,
