@@ -35,7 +35,7 @@ def main():
                     n_layer=8, n_head=8, n_embd=512, dropout=0.0,
                     attn_impl="flash")
     res = Trainer(GPT(cfg), dataset_factory, dataset_factory).fit(
-        max_steps=1000,
+        max_steps=int(os.environ.get("PLAYGROUND_STEPS", 1000)),
         strategy=DiLoCoStrategy(
             optim_spec=OptimSpec("adamw", lr=3e-4), H=100,
             lr_scheduler="lambda_cosine",

@@ -297,3 +297,17 @@ def test_fit_pp_multi_step_dispatch_and_autocast():
     assert np.all(np.isfinite(lb))
     assert np.mean(lb[-3:]) < np.mean(lb[:3])
     assert all(np.isfinite(v) for _, v in rb.history["global_loss"])
+
+
+def test_fit_pp_composes_with_partial_participation():
+    """Fault simulation (shared-PRNG partial participation on DiLoCo's
+    outer round) composes with pipeline parallelism: the alive-mask and
+    gather run over the node axes only, orthogonal to the pipe axis."""
+    from gym_tpu.strategy.diloco import DiLoCoStrategy
+    from gym_tpu.strategy.optim import OptimSpec
+
+    res = _pp_fit(pp=2, num_nodes=4,
+                  strategy=DiLoCoStrategy(OptimSpec("adamw", lr=1e-3),
+                                          H=2, participation=0.5))
+    losses = [l for _, l in res.history["train_loss"]]
+    assert len(losses) == 6 and np.all(np.isfinite(losses))
