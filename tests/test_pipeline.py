@@ -306,8 +306,17 @@ def test_fit_pp_composes_with_partial_participation():
     from gym_tpu.strategy.diloco import DiLoCoStrategy
     from gym_tpu.strategy.optim import OptimSpec
 
-    res = _pp_fit(pp=2, num_nodes=4,
-                  strategy=DiLoCoStrategy(OptimSpec("adamw", lr=1e-3),
-                                          H=2, participation=0.5))
+    def run(participation):
+        return _pp_fit(pp=2, num_nodes=4,
+                       strategy=DiLoCoStrategy(OptimSpec("adamw", lr=1e-3),
+                                               H=2,
+                                               participation=participation))
+
+    res = run(0.5)
     losses = [l for _, l in res.history["train_loss"]]
     assert len(losses) == 6 and np.all(np.isfinite(losses))
+    # the fault path actually fired: after the first outer round (H=2)
+    # the dropped-node trajectory diverges from full participation
+    full = [l for _, l in run(1.0).history["train_loss"]]
+    assert losses[:2] == full[:2]          # identical until the round
+    assert any(abs(a - b) > 1e-7 for a, b in zip(losses[3:], full[3:]))
