@@ -56,7 +56,9 @@ def make_tp_mesh(devices=None, dp: Optional[int] = None,
 def _spec_for_path(path: str, ndim: int) -> P:
     """Megatron-style sharding rule for a GPT param, by its tree path."""
     if "embedding" in path:               # wte [V, D] / wpe [T, D]
-        if path.startswith("wte"):
+        # substring, not startswith: the pipeline layout prefixes paths
+        # with "outer/" (gpt_pipeline_param_specs)
+        if "wte" in path:
             return P(MODEL_AXIS, None)    # vocab-sharded (tied lm_head)
         return P()                        # wpe: small, replicate
     if ndim < 2:
@@ -89,6 +91,25 @@ def gpt_param_specs(params: PyTree) -> PyTree:
         [_spec_for_path(p, getattr(x, "ndim", 0))
          for p, x in zip(paths, leaves)],
     )
+
+
+def gpt_pipeline_param_specs(pipe_params: PyTree) -> PyTree:
+    """Megatron specs for the PIPELINE param layout
+    (``parallel/pipeline_model.py``: ``{"outer", "stages"}``): outer
+    leaves take the plain rules; stage-stacked leaves ([S_tile, L/S, ...]
+    per device) take the rule for their path with two leading ``None``
+    dims prepended (the stage tile + per-stage layer axes are never
+    tensor-sharded — ``'pipe'`` owns the stage axis)."""
+    paths, leaves, treedef = _tree_paths(pipe_params)
+    out = []
+    for path, leaf in zip(paths, leaves):
+        ndim = getattr(leaf, "ndim", 0)
+        if path.startswith("stages/"):
+            base = _spec_for_path(path, ndim - 2)
+            out.append(P(None, None, *base) if len(base) else P())
+        else:
+            out.append(_spec_for_path(path, ndim))
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def gpt_param_shardings(params: PyTree, mesh: Mesh) -> PyTree:

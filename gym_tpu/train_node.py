@@ -208,7 +208,8 @@ def make_pipeline_init_fn(pipe_model, strategy: Strategy, example_micro,
 
 
 def make_pipeline_train_step(pipe_model, strategy: Strategy, ctx: AxisCtx,
-                             skip_nonfinite: bool = False):
+                             skip_nonfinite: bool = False,
+                             param_specs=None):
     """Pipelined ``node_step``: the grad-accum microbatches [n_micro, ...]
     are consumed in ONE ``pipe_loss`` call — they are the GPipe schedule's
     M — and the backward pass is autodiff of the schedule. Gradients of
@@ -216,9 +217,17 @@ def make_pipeline_train_step(pipe_model, strategy: Strategy, ctx: AxisCtx,
     params (embeddings: stage 0; tied head: stage S−1) are combined with
     one ``pp_psum``. Everything downstream (strategy collectives over the
     node axes, metrics) is unchanged — pipeline composes with any
-    tree-mapped strategy."""
+    tree-mapped strategy.
+
+    ``param_specs``: Megatron constraints for the pipeline layout
+    (``tensor_parallel.gpt_pipeline_param_specs``) — the pp×tp
+    composition: stages stay manual over 'pipe' while GSPMD shards each
+    stage's matmuls over the auto 'model' axis."""
 
     def node_step(state: TrainState, batch) -> Tuple[TrainState, Dict]:
+        if param_specs is not None:
+            state = state.replace(
+                params=constrain_params(state.params, param_specs))
         step_rng = jax.random.fold_in(state.rng, state.step)
 
         def loss_fn(params):
@@ -251,6 +260,7 @@ def make_pipeline_train_step(pipe_model, strategy: Strategy, ctx: AxisCtx,
         params, sstate, metrics = strategy.step(
             grads, state.params, state.strategy_state, state.step, ctx
         )
+        params = constrain_params(params, param_specs)
         new_state = state.replace(
             params=params,
             model_state=model_state,

@@ -214,8 +214,8 @@ class Trainer:
             from .strategy.zero_reduce import ZeroReduceStrategy
             if not isinstance(loss_model.module, _GPT):
                 raise ValueError("pp > 1 requires a GPT model")
-            if cp > 1 or tp > 1 or ep > 1:
-                raise ValueError("pp does not compose with cp/tp/ep yet")
+            if cp > 1 or ep > 1:
+                raise ValueError("pp does not compose with cp/ep yet")
             flat_layout = any(
                 getattr(m, "shard_outer", False)
                 for m in getattr(strategy, "communication_modules", []))
@@ -267,7 +267,7 @@ class Trainer:
         # sharded over the 'model' mesh axis via sharding constraints; the
         # specs come from the model family's rules (GPT only for now).
         param_specs = None
-        if tp > 1 or ep > 1:
+        if (tp > 1 or ep > 1) and pipe_model is None:
             # shape inference runs OUTSIDE the mesh program, where a
             # seq-sharded model's axis_size('seq') query would be unbound
             # (cp × ep composition) — param shapes don't depend on the
@@ -284,7 +284,7 @@ class Trainer:
                 lambda: shape_model.init(jax.random.PRNGKey(0),
                                          example_micro)
             )
-        if tp > 1:
+        if tp > 1 and pipe_model is None:
             from .models.nanogpt import GPT as _GPT
             from .parallel.tensor_parallel import gpt_param_specs
             if not isinstance(loss_model.module, _GPT):
@@ -312,6 +312,13 @@ class Trainer:
             state_shapes = jax.eval_shape(
                 shape_fn, jax.ShapeDtypeStruct((), jnp.int32))
             state_specs = pipeline_state_specs(state_shapes)
+            if tp > 1:
+                # pp × tp: Megatron constraints in the PIPELINE layout —
+                # 'pipe' stays manual over the stage axis while GSPMD
+                # shards each stage's matmuls over the auto 'model' axis
+                from .parallel.tensor_parallel import (
+                    gpt_pipeline_param_specs)
+                param_specs = gpt_pipeline_param_specs(state_shapes.params)
             state = runtime.init_state(init_fn, state_specs)
         else:
             init_fn = make_init_fn(loss_model, strategy, example_micro,
@@ -335,7 +342,8 @@ class Trainer:
             from .train_node import (make_pipeline_eval_step,
                                      make_pipeline_train_step)
             pstep = make_pipeline_train_step(pipe_model, strategy,
-                                             runtime.ctx, skip_nonfinite)
+                                             runtime.ctx, skip_nonfinite,
+                                             param_specs)
             io_specs = dict(in_specs=(state_specs, P(NODE_AXIS)),
                             out_specs=(state_specs, P(NODE_AXIS)))
             train_step = runtime.compile(pstep, **io_specs)

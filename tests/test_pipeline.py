@@ -320,3 +320,19 @@ def test_fit_pp_composes_with_partial_participation():
     full = [l for _, l in run(1.0).history["train_loss"]]
     assert losses[:2] == full[:2]          # identical until the round
     assert any(abs(a - b) > 1e-7 for a, b in zip(losses[3:], full[3:]))
+
+
+def test_fit_pp2_tp2_matches_unsharded():
+    """pp x tp: a ('node','pipe','model') mesh — GPipe stages manual over
+    'pipe' while GSPMD Megatron-shards each stage's matmuls over the auto
+    'model' axis (gpt_pipeline_param_specs). Same trajectory as the
+    unsharded run: composition is a schedule, not an algorithm change."""
+    if len(jax.devices()) < 8:
+        import pytest
+        pytest.skip("needs 8 devices")
+    with jax.default_matmul_precision("highest"):
+        r0 = _pp_fit(pp=1)
+        r = _pp_fit(pp=2, tp=2)
+    a = [l for _, l in r0.history["train_loss"]]
+    b = [l for _, l in r.history["train_loss"]]
+    np.testing.assert_allclose(b, a, rtol=2e-4, atol=1e-5)
