@@ -184,11 +184,14 @@ def make_multi_train_step(loss_model: LossModel, strategy: Strategy,
 
 def make_pipeline_init_fn(pipe_model, strategy: Strategy, example_micro,
                           seed: int, ctx: AxisCtx = None,
-                          static_stage=None):
+                          static_stage=None, param_specs=None):
     """Per-node init for the pipelined model (``parallel/pipeline_model``):
     same seed ⇒ same full-model weights as a ``pp=1`` run, each device
     keeping its own stage slice. ``static_stage`` pins the slice for
-    shape inference (``jax.eval_shape``) outside the mesh program."""
+    shape inference (``jax.eval_shape``) outside the mesh program.
+    ``param_specs`` (pp×tp): Megatron constraints applied BEFORE
+    ``strategy.init`` so the whole state inherits the 'model'-axis layout
+    from the start — same contract as ``make_init_fn``."""
     if ctx is not None:
         strategy.bind_ctx(ctx)
 
@@ -196,6 +199,7 @@ def make_pipeline_init_fn(pipe_model, strategy: Strategy, example_micro,
         base = jax.random.PRNGKey(seed)
         params, model_state = pipe_model.init(base, example_micro,
                                               static_stage=static_stage)
+        params = constrain_params(params, param_specs)
         return TrainState(
             params=params,
             model_state=model_state,

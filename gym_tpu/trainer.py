@@ -304,8 +304,6 @@ class Trainer:
             import jax.numpy as jnp
             from .parallel.pipeline_model import pipeline_state_specs
             from .train_node import make_pipeline_init_fn
-            init_fn = make_pipeline_init_fn(
-                pipe_model, strategy, example_micro, seed, ctx=runtime.ctx)
             shape_fn = make_pipeline_init_fn(
                 pipe_model, strategy, example_micro, seed, ctx=runtime.ctx,
                 static_stage=0)
@@ -319,6 +317,9 @@ class Trainer:
                 from .parallel.tensor_parallel import (
                     gpt_pipeline_param_specs)
                 param_specs = gpt_pipeline_param_specs(state_shapes.params)
+            init_fn = make_pipeline_init_fn(
+                pipe_model, strategy, example_micro, seed, ctx=runtime.ctx,
+                param_specs=param_specs)
             state = runtime.init_state(init_fn, state_specs)
         else:
             init_fn = make_init_fn(loss_model, strategy, example_micro,

@@ -323,7 +323,7 @@ def test_fit_pp_composes_with_partial_participation():
 
 
 def test_fit_pp2_tp2_matches_unsharded():
-    """pp x tp: a ('node','pipe','model') mesh — GPipe stages manual over
+    """pp x tp: a ('node','model','pipe') mesh — GPipe stages manual over
     'pipe' while GSPMD Megatron-shards each stage's matmuls over the auto
     'model' axis (gpt_pipeline_param_specs). Same trajectory as the
     unsharded run: composition is a schedule, not an algorithm change."""
