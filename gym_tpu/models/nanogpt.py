@@ -80,6 +80,13 @@ class GPTConfig:
     def is_moe_layer(self, i: int) -> bool:
         return self.n_experts > 0 and i % self.moe_every == self.moe_every - 1
 
+    def without_seq_sharding(self) -> "GPTConfig":
+        """Clone with the sequence sharding stripped — for tracing outside
+        the mesh (shape inference, init), where ``axis_size(seq_axis)``
+        would be unbound. Param shapes are identical."""
+        import dataclasses
+        return dataclasses.replace(self, seq_axis=None, attn_impl="dense")
+
     @classmethod
     def gpt2_size_map(cls, size: str) -> "GPTConfig":
         return {
@@ -296,16 +303,8 @@ class GPT(nn.Module):
             assert cfg.attn_impl == "ring", (
                 f"seq_axis requires attn_impl='ring', got {cfg.attn_impl!r}"
             )
-            cp = jax.lax.axis_size(cfg.seq_axis)
-            assert t % cp == 0, f"seq len {t} not divisible by cp={cp}"
-            tl = t // cp
-            chunk = jax.lax.axis_index(cfg.seq_axis)
-            idx = jax.lax.dynamic_slice_in_dim(idx, chunk * tl, tl, axis=1)
-            if targets is not None:
-                targets = jax.lax.dynamic_slice_in_dim(
-                    targets, chunk * tl, tl, axis=1
-                )
-            pos = chunk * tl + jnp.arange(tl)[None, :]
+            idx, targets, pos0 = slice_seq_chunk(idx, targets, cfg.seq_axis)
+            pos = pos0 + jnp.arange(idx.shape[1])[None, :]
         else:
             pos = jnp.arange(t)[None, :]
         wte = nn.Embed(cfg.vocab_size, cfg.n_embd,
@@ -349,6 +348,23 @@ class GPT(nn.Module):
 
 
 # -- model utilities (reference parity helpers) ----------------------------
+
+
+def slice_seq_chunk(idx, targets, seq_axis: str, axis: int = 1):
+    """THE context-parallel slicing contract, shared by ``GPT.__call__``
+    and the pipelined loss (``parallel/pipeline_model.py``): this device
+    owns one contiguous token chunk of the ``seq_axis`` group. Returns
+    ``(idx_chunk, targets_chunk, position_offset)``."""
+    sp = jax.lax.axis_size(seq_axis)
+    t = idx.shape[axis]
+    assert t % sp == 0, f"seq len {t} not divisible by cp={sp}"
+    tl = t // sp
+    chunk = jax.lax.axis_index(seq_axis)
+    idx = jax.lax.dynamic_slice_in_dim(idx, chunk * tl, tl, axis=axis)
+    if targets is not None:
+        targets = jax.lax.dynamic_slice_in_dim(targets, chunk * tl, tl,
+                                               axis=axis)
+    return idx, targets, chunk * tl
 
 
 def ce_sum_count(x, targets, embedding, loss_chunk: int):

@@ -77,13 +77,23 @@ class PipelinedGPTLossModel:
             "pipeline parallelism requires dropout=0 (per-tick rng plumbing "
             "through the schedule is not supported)")
         assert config.n_experts == 0, "pp does not compose with MoE yet"
-        assert config.seq_axis is None, "pp does not compose with cp yet"
+        if config.seq_axis is not None:
+            # pp × cp: each stage's attention rings over the 'seq' axis;
+            # pipe_loss slices the node's token chunk exactly like
+            # GPT.__call__ does under cp
+            assert config.attn_impl == "ring", (
+                "seq_axis under pp requires attn_impl='ring'")
         self.config = config
         self.n_stages = n_stages
         self.compute_dtype = compute_dtype
         # .module: the underlying GPT, for config capture / MFU in the
         # trainer (same attribute contract as LossModel)
         self.module = GPT(config)
+        # init traces a seq-axis-free clone: param shapes don't depend on
+        # the sequence sharding, and shape inference (jax.eval_shape,
+        # static_stage) runs outside the mesh where 'seq' is unbound
+        self._init_module = (GPT(config.without_seq_sharding())
+                             if config.seq_axis is not None else self.module)
 
     def init(self, rng: jax.Array, example_micro,
              static_stage: Optional[int] = None) -> Tuple[PyTree, PyTree]:
@@ -92,7 +102,7 @@ class PipelinedGPTLossModel:
         shape inference outside ``shard_map``; inside, the stage comes from
         ``lax.axis_index('pipe')``."""
         p_rng, d_rng = jax.random.split(rng)
-        variables = self.module.init(
+        variables = self._init_module.init(
             {"params": p_rng, "dropout": d_rng}, example_micro, train=False)
         split = split_gpt_params(dict(variables["params"]),
                                  self.n_stages, self.config.n_layer)
@@ -127,9 +137,18 @@ class PipelinedGPTLossModel:
                 if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
             outer, stages = cast(outer), cast(stages)
 
+        pos0 = 0
+        if cfg.seq_axis is not None:
+            # context parallelism: this device owns one contiguous token
+            # chunk — the shared cp slicing contract
+            from ..models.nanogpt import slice_seq_chunk
+            idx, targets, pos0 = slice_seq_chunk(idx, targets,
+                                                 cfg.seq_axis, axis=2)
+            t = idx.shape[2]
+
         wte = outer["wte"]["embedding"]
         wpe = outer["wpe"]["embedding"]
-        x = wte[idx] + wpe[jnp.arange(t)][None, None]      # [M, B, T, C]
+        x = wte[idx] + wpe[pos0 + jnp.arange(t)][None, None]  # [M, B, T, C]
 
         block = Block(cfg)
         stage_fn = functools.partial(
@@ -150,6 +169,12 @@ class PipelinedGPTLossModel:
         sums, counts = jax.vmap(
             lambda xm, tm: ce_sum_count(xm, tm, wte, cfg.loss_chunk)
         )(ln, targets)                                     # [M], [M]
+        if cfg.seq_axis is not None:
+            # combine the seq chunks' CE in-model, like GPT.__call__
+            # under cp; the matching grad combination is seq_psum in
+            # make_pipeline_train_step
+            sums = lax.psum(sums, cfg.seq_axis)
+            counts = lax.psum(counts, cfg.seq_axis)
         mean_loss = jnp.mean(sums / jnp.maximum(counts, 1.0))
         local = jnp.where(is_last, mean_loss, 0.0)
         return jnp.asarray(local, jnp.float32), model_state

@@ -243,6 +243,9 @@ def make_pipeline_train_step(pipe_model, strategy: Strategy, ctx: AxisCtx,
         (loss_local, model_state), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
         loss = jax.lax.psum(loss_local, ctx.pp_axes)  # replicated metric
+        # cp composition: each seq device's backward carries only its
+        # token chunk's contribution — combine, same as make_train_step
+        grads = ctx.seq_psum(grads)
         grads = {"outer": ctx.pp_psum(grads["outer"]),
                  "stages": grads["stages"]}
 

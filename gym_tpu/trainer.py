@@ -214,8 +214,8 @@ class Trainer:
             from .strategy.zero_reduce import ZeroReduceStrategy
             if not isinstance(loss_model.module, _GPT):
                 raise ValueError("pp > 1 requires a GPT model")
-            if cp > 1 or ep > 1:
-                raise ValueError("pp does not compose with cp/ep yet")
+            if ep > 1:
+                raise ValueError("pp does not compose with ep yet")
             flat_layout = any(
                 getattr(m, "shard_outer", False)
                 for m in getattr(strategy, "communication_modules", []))
@@ -275,11 +275,9 @@ class Trainer:
             shape_model = loss_model
             mod_cfg = getattr(loss_model.module, "config", None)
             if getattr(mod_cfg, "seq_axis", None) is not None:
-                import dataclasses as _dc
-
                 from .models.nanogpt import GPT as _GPT
-                shape_model = LossModel(_GPT(_dc.replace(
-                    mod_cfg, seq_axis=None, attn_impl="dense")))
+                shape_model = LossModel(
+                    _GPT(mod_cfg.without_seq_sharding()))
             shapes = jax.eval_shape(
                 lambda: shape_model.init(jax.random.PRNGKey(0),
                                          example_micro)

@@ -336,3 +336,48 @@ def test_fit_pp2_tp2_matches_unsharded():
     a = [l for _, l in r0.history["train_loss"]]
     b = [l for _, l in r.history["train_loss"]]
     np.testing.assert_allclose(b, a, rtol=2e-4, atol=1e-5)
+
+
+def test_fit_pp2_cp2_matches_unsharded():
+    """pp x cp: a ('node','seq','pipe') mesh — ring attention over 'seq'
+    INSIDE each GPipe stage, token chunks sliced per seq device in
+    pipe_loss (the GPT.__call__ cp contract), CE psum'd over seq
+    in-model with the matching seq_psum of grads in the step. Same
+    trajectory as the unsharded run."""
+    import dataclasses
+
+    from gym_tpu.data.gpt_datasets import ContiguousGPTTrainDataset
+    from gym_tpu.models.nanogpt import GPT, GPTConfig
+    from gym_tpu.strategy.diloco import DiLoCoStrategy
+    from gym_tpu.strategy.optim import OptimSpec
+    from gym_tpu.trainer import Trainer
+
+    if len(jax.devices()) < 8:
+        import pytest
+        pytest.skip("needs 8 devices")
+
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 32, 4096, dtype=np.int64)
+
+    def factory(rank, nn_, is_val):
+        return ContiguousGPTTrainDataset(data, block_size=16)
+
+    def run(pp, cp):
+        cfg = GPTConfig(block_size=16, vocab_size=32, n_layer=4, n_head=2,
+                        n_embd=32, dropout=0.0,
+                        attn_impl="ring" if cp > 1 else "dense",
+                        seq_axis="seq" if cp > 1 else None)
+        return Trainer(GPT(cfg), factory, factory).fit(
+            num_nodes=2,
+            strategy=DiLoCoStrategy(OptimSpec("adamw", lr=1e-3), H=3),
+            max_steps=6, batch_size=8, minibatch_size=2, val_size=16,
+            val_interval=3, pp=pp, cp=cp, show_progress=False,
+            log_dir="/tmp/gym_tpu_test_logs")
+
+    with jax.default_matmul_precision("highest"):
+        r0 = run(1, 1)
+        r = run(2, 2)
+    for key in ("train_loss", "global_loss"):
+        a = [l for _, l in r0.history[key]]
+        b = [l for _, l in r.history[key]]
+        np.testing.assert_allclose(b, a, rtol=2e-4, atol=1e-5)
