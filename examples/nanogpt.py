@@ -130,6 +130,8 @@ def main():
     p.add_argument("--minibatch_size", type=int, default=None)
     # optimization (:61-67)
     p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--dropout", type=float, default=0.0,
+                   help="model dropout rate (reference nanogpt.py:141)")
     p.add_argument("--max_norm", type=float, default=1.0)
     p.add_argument("--warmup_steps", type=int, default=100)
     p.add_argument("--cosine_anneal", action="store_true")
@@ -218,6 +220,7 @@ def main():
     cfg.block_size = args.block_size
     cfg.attn_impl = attn
     cfg.seq_axis = "seq" if attn == "ring" else None
+    cfg.dropout = args.dropout
     if args.n_experts:
         cfg.n_experts = args.n_experts
         cfg.expert_topk = args.expert_topk

@@ -163,21 +163,30 @@ class NodeBatchIterator:
             need -= take
         return np.concatenate(out) if len(out) > 1 else out[0]
 
-    def next_batch(self, n_micro: int, micro_bs: int):
-        """Fetch [K, n_micro, micro_bs, ...] arrays for one step."""
-        per_node = []
+    def next_batch(self, n_micro: int, micro_bs: int, nodes=None):
+        """Fetch [K, n_micro, micro_bs, ...] arrays for one step.
+
+        ``nodes``: in a multi-process world each host passes ITS node
+        subset (mesh order) and gets [len(nodes), ...] arrays — only
+        those nodes' data is materialized, but every node's index cursor
+        still advances so epoch boundaries and the checkpointable
+        iterator state stay identical on every host (the property that
+        makes per-host data loading scale — reference
+        ``DistributedSampler`` semantics at host granularity)."""
+        wanted = set(range(self.num_nodes) if nodes is None else nodes)
+        order = list(range(self.num_nodes)) if nodes is None else list(nodes)
+        per_node = {}
         for n in range(self.num_nodes):
             idx = self._next_indices(n, n_micro * micro_bs)
+            if n not in wanted:
+                continue
             arrs = self.datasets[n].take(idx)
-            per_node.append(
-                tuple(
-                    a.reshape((n_micro, micro_bs) + a.shape[1:]) for a in arrs
-                )
+            per_node[n] = tuple(
+                a.reshape((n_micro, micro_bs) + a.shape[1:]) for a in arrs
             )
-        # stack over nodes → leading K axis
-        n_fields = len(per_node[0])
+        n_fields = len(next(iter(per_node.values())))
         return tuple(
-            np.stack([per_node[n][j] for n in range(self.num_nodes)])
+            np.stack([per_node[n][j] for n in order])
             for j in range(n_fields)
         )
 

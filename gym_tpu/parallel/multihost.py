@@ -65,6 +65,44 @@ def initialize(
     return True
 
 
+_NODE_MAP_CACHE: dict = {}
+
+
+def _local_node_map(mesh, process_index: Optional[int] = None):
+    """This process's mesh devices and their node-axis coordinates:
+    ``(local_devs, coord, row_of, n_local_coords)``. The argwhere scans
+    are O(local_devs × mesh_size) on a host object array — cached per
+    (mesh, process) so the per-step ``global_batch`` path never
+    recomputes them (the map is fixed for a mesh's lifetime)."""
+    import numpy as np
+
+    mesh_devs = list(mesh.devices.flat)
+    if process_index is None:
+        # the process index of the MESH's backend — jax.process_index()
+        # reads the default backend, which can be a different platform
+        # (e.g. a single-process TPU plugin alongside a multi-process CPU
+        # world) and then reports 0 in every process
+        process_index = mesh_devs[0].client.process_index()
+    key = (id(mesh), process_index)
+    hit = _NODE_MAP_CACHE.get(key)
+    if hit is not None and hit[0] is mesh:
+        return hit[1]
+    mesh_arr = mesh.devices
+    local_devs = [d for d in mesh_devs if d.process_index == process_index]
+    assert local_devs, f"process {process_index} owns no mesh devices"
+    # A batch is sharded over the 'node' (first) mesh axis only and
+    # REPLICATED over any cp/tp/ep/pp axes — devices sharing a node-axis
+    # coordinate hold the same rows. Map each local device to its node
+    # coordinate; local_tree rows are ordered by this process's node
+    # coordinates.
+    coord = {d: int(np.argwhere(mesh_arr == d)[0][0]) for d in local_devs}
+    local_coords = sorted(set(coord.values()))
+    row_of = {c: i for i, c in enumerate(local_coords)}
+    out = (local_devs, coord, row_of, len(local_coords))
+    _NODE_MAP_CACHE[key] = (mesh, out)  # keep mesh alive ⇒ id() stays valid
+    return out
+
+
 def global_batch(runtime, local_tree, process_index: Optional[int] = None):
     """Assemble a *global* node-sharded batch from process-local data.
 
@@ -76,37 +114,21 @@ def global_batch(runtime, local_tree, process_index: Optional[int] = None):
     materializes another host's data (the property that makes per-host
     data loading scale, reference ``DistributedSampler`` semantics at host
     granularity)."""
-    import numpy as np
     from jax.sharding import NamedSharding
 
     sharding: NamedSharding = runtime.node_sharding
-    mesh_arr = runtime.mesh.devices
-    mesh_devs = list(mesh_arr.flat)
-    if process_index is None:
-        # the process index of the MESH's backend — jax.process_index()
-        # reads the default backend, which can be a different platform
-        # (e.g. a single-process TPU plugin alongside a multi-process CPU
-        # world) and then reports 0 in every process
-        process_index = mesh_devs[0].client.process_index()
-    local_devs = [d for d in mesh_devs if d.process_index == process_index]
-    assert local_devs, f"process {process_index} owns no mesh devices"
+    local_devs, coord, row_of, n_local = _local_node_map(runtime.mesh,
+                                                        process_index)
 
-    # A batch is sharded over the 'node' (first) mesh axis only and
-    # REPLICATED over any cp/tp/ep axes — devices sharing a node-axis
-    # coordinate hold the same rows. Map each local device to its node
-    # coordinate; local_tree rows are ordered by this process's node
-    # coordinates.
-    coord = {d: int(np.argwhere(mesh_arr == d)[0][0]) for d in local_devs}
-    local_coords = sorted(set(coord.values()))
-    row_of = {c: i for i, c in enumerate(local_coords)}
+    import numpy as np
 
     def build(x):
         x = np.asarray(x)
-        assert x.shape[0] % len(local_coords) == 0, (
+        assert x.shape[0] % n_local == 0, (
             f"local leading axis {x.shape[0]} not divisible by this "
-            f"process's {len(local_coords)} node-axis shards"
+            f"process's {n_local} node-axis shards"
         )
-        per = x.shape[0] // len(local_coords)
+        per = x.shape[0] // n_local
         k_global = per * runtime.n_phys
         shards = [
             jax.device_put(

@@ -34,12 +34,13 @@ PIPE_AXIS = "pipe"
 
 
 def pipeline_apply(
-    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stage_fn: Callable[..., Any],
     stage_params: Any,
     xs: jnp.ndarray,
     n_stages: int,
     axis_name: str = PIPE_AXIS,
     replicate_out: bool = True,
+    with_aux: bool = False,
 ) -> jnp.ndarray:
     """Run M microbatches through S = ``n_stages`` pipeline stages.
 
@@ -47,10 +48,20 @@ def pipeline_apply(
     ``stage_params`` already sharded to this device's stage (e.g. a
     stacked-layer tree whose leading stage axis the mesh consumed).
 
+    ``stage_fn(stage_params, x, m_idx) -> y`` (or ``(y, aux)`` under
+    ``with_aux``): ``m_idx`` is the index of the microbatch this stage is
+    processing this tick — fold it into per-microbatch rng (dropout).
+    During the (S−1) bubble ticks ``m_idx`` is clipped into [0, M−1] and
+    the garbage compute is masked out of the output and the aux sum.
+
     ``xs``: [M, ...] microbatch activations fed to stage 0 (replicated on
     every stage; only stage 0 reads them). Returns [M, ...] — the last
     stage's outputs, shared to every stage via a masked ``psum`` so the
     caller can continue with replicated compute (loss head, logging).
+    Under ``with_aux`` returns ``(out, aux_sum)`` where ``aux_sum`` is
+    THIS STAGE's sum of per-microbatch aux scalars over its valid ticks
+    (``psum`` it over the pipe axis for the model total — stage-local by
+    design so the loss head can keep single-source gradient seeding).
 
     ``replicate_out=False`` skips that psum and returns each stage's raw
     output buffer — only the LAST stage's is meaningful. Use when the
@@ -72,18 +83,27 @@ def pipeline_apply(
     fwd = [(i, i + 1) for i in range(n_stages - 1)]
 
     def tick(carry, t):
-        inbox, out = carry
+        inbox, out, aux_sum = carry
         x0 = lax.dynamic_index_in_dim(xs, jnp.clip(t, 0, m - 1), 0,
                                       keepdims=False)
         xin = jnp.where(is_first, x0, inbox)
-        y = stage_fn(stage_params, xin)
+        # microbatch index at this stage this tick (garbage during bubble
+        # ticks, clipped so rng folding stays in range)
+        m_idx = jnp.clip(t - sid, 0, m - 1)
+        res = stage_fn(stage_params, xin, m_idx)
+        if with_aux:
+            y, aux = res
+            valid = jnp.logical_and(t >= sid, t - sid <= m - 1)
+            aux_sum = aux_sum + jnp.where(valid, aux, 0.0)
+        else:
+            y = res
         # the microbatch leaving the LAST stage at tick t is t-(S-1)
         widx = jnp.clip(t - (n_stages - 1), 0, m - 1)
         prev = lax.dynamic_index_in_dim(out, widx, 0, keepdims=False)
         out = lax.dynamic_update_index_in_dim(
             out, jnp.where(t >= n_stages - 1, y, prev), widx, 0)
         inbox = lax.ppermute(y, axis_name, fwd)
-        return (inbox, out), None
+        return (inbox, out, aux_sum), None
 
     # the carry is stage-varying (each stage holds different activations):
     # mark the zero init as varying over the pipe axis or the scan's carry
@@ -96,12 +116,14 @@ def pipeline_apply(
             return lax.pvary(x, (axis_name,))
     out0 = _vary(jnp.zeros_like(xs))
     inbox0 = _vary(jnp.zeros_like(xs[0]))
-    (_, out), _ = lax.scan(tick, (inbox0, out0),
-                           jnp.arange(m + n_stages - 1))
-    if not replicate_out:
-        return out
-    # only the last stage holds real outputs; share them with every stage
-    return lax.psum(jnp.where(is_last, out, jnp.zeros_like(out)), axis_name)
+    aux0 = _vary(jnp.zeros((), jnp.float32))
+    (_, out, aux_sum), _ = lax.scan(tick, (inbox0, out0, aux0),
+                                    jnp.arange(m + n_stages - 1))
+    if replicate_out:
+        # only the last stage holds real outputs; share them everywhere
+        out = lax.psum(jnp.where(is_last, out, jnp.zeros_like(out)),
+                       axis_name)
+    return (out, aux_sum) if with_aux else out
 
 
 def take_stage(stage_params: Any) -> Any:
@@ -126,13 +148,17 @@ def stack_stage_params(per_layer_params: list, n_stages: int) -> Any:
     )
 
 
-def apply_stage_layers(layer_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+def apply_stage_layers(layer_fn: Callable[..., jnp.ndarray],
                        stage_params: Any, x: jnp.ndarray) -> jnp.ndarray:
     """Apply a stage's stacked layers ([L/S, ...] leading axis) in order —
-    a `lax.scan` so the stage compiles once regardless of depth."""
+    a `lax.scan` so the stage compiles once regardless of depth.
+    ``layer_fn(layer_params, h, li)``: ``li`` is the layer's index WITHIN
+    the stage (traced int32 — fold into per-layer rng for dropout)."""
+    n_local = jax.tree.leaves(stage_params)[0].shape[0]
 
-    def body(h, layer_params):
-        return layer_fn(layer_params, h), None
+    def body(h, inp):
+        li, layer_params = inp
+        return layer_fn(layer_params, h, li), None
 
-    out, _ = lax.scan(body, x, stage_params)
+    out, _ = lax.scan(body, x, (jnp.arange(n_local), stage_params))
     return out

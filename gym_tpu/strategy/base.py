@@ -159,7 +159,24 @@ class Strategy(abc.ABC):
             cfg.update(spec.config())
         return cfg
 
-    def _maybe_clip(self, grads: PyTree) -> PyTree:
-        if self.max_norm:
-            return clip_by_global_norm(grads, self.max_norm)
-        return grads
+    def _maybe_clip(self, grads: PyTree, ctx: AxisCtx = None) -> PyTree:
+        """Global-norm clip. Under pipeline parallelism (``ctx.pp_axes``
+        and the pipeline grad layout ``{"outer", "stages"}``) the true
+        global norm counts the replicated outer grads ONCE and sums the
+        stage-local parts over the pipe group — a per-device norm would
+        give each stage a different clip scale, silently desyncing the
+        replicated outer params (embeddings/tied head) across the pipe
+        group forever."""
+        if not self.max_norm:
+            return grads
+        if (ctx is not None and ctx.pp_axes and isinstance(grads, dict)
+                and set(grads.keys()) == {"outer", "stages"}):
+            def sq(t):
+                return sum(jnp.sum(jnp.square(x))
+                           for x in jax.tree.leaves(t))
+            total = sq(grads["outer"]) + jax.lax.psum(
+                sq(grads["stages"]), ctx.pp_axes)
+            scale = jnp.minimum(
+                1.0, self.max_norm / (jnp.sqrt(total) + 1e-6))
+            return jax.tree.map(lambda x: x * scale, grads)
+        return clip_by_global_norm(grads, self.max_norm)

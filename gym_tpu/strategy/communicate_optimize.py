@@ -86,7 +86,7 @@ class CommunicateOptimizeStrategy(Strategy):
         return None  # None = always
 
     def step(self, grads, params, state, step, ctx):
-        grads = self._maybe_clip(grads)
+        grads = self._maybe_clip(grads, ctx)
         updates, opt_state = self.tx.update(grads, state["opt"], params)
         params = optax.apply_updates(params, updates)
 

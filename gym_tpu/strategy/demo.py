@@ -36,6 +36,7 @@ from ..ops.topk_compress import (mean_weights, scatter_mean_decode,
                                  topk_compress)
 from .base import PyTree, Strategy
 from .optim import OptimSpec, ensure_optim_spec
+from .sharding import pipe_unwrap, pipe_wrap
 
 
 def _segmented(fn, n_chunks: int, n_seg: int, *arrays):
@@ -140,14 +141,22 @@ class DeMoStrategy(Strategy):
         # Flat [G, a·b] rather than [G, a, b]: the TPU (8, 128) tile
         # layout pads a 64-wide minor dim to 128 lanes — 2× wasted HBM on
         # every pooled buffer at the default chunk size.
+        # CHECKPOINT COMPAT (ADVICE r3): this flat layout (and the
+        # delta_dtype storage dtype) replaced round 2's [G, a, b] f32
+        # layout — an Orbax checkpoint written before that change fails
+        # restore with a template shape/dtype mismatch on the
+        # 'delta/{a}x{b}' arrays. That break is intentional (no shim):
+        # re-train or restore with the old code and re-save.
         p_leaves, _ = jax.tree.flatten(params)
         codecs, groups = self._groups(p_leaves)
         dt = self.delta_dtype or jnp.float32
-        return {"delta": {
+        # under pipeline parallelism the pooled residuals chunk THIS
+        # STAGE's param view — pipe-varying state (sharding.pipe_wrap)
+        return pipe_wrap({"delta": {
             f"{a}x{b}": jnp.zeros(
                 (sum(codecs[i].n_chunks for i in ids), a * b), dt)
             for (a, b), ids in groups.items()
-        }}
+        }}, self._ctx)
 
     def _n_segments(self, n_chunks: int, a: int, b: int) -> int:
         """Segments needed to keep one [·, a, b] f32 working set under
@@ -167,7 +176,8 @@ class DeMoStrategy(Strategy):
         return base * self._lr_scale(step)
 
     def step(self, grads, params, state, step, ctx):
-        grads = self._maybe_clip(grads)
+        grads = self._maybe_clip(grads, ctx)
+        state = pipe_unwrap(state, ctx)
         lr = self._lr(step)
         beta = self.compression_decay
         topk = self.compression_topk
@@ -281,7 +291,7 @@ class DeMoStrategy(Strategy):
         # data_receive counters (demo_impl/demo.py:145-146, 187-190)
         return (
             new_params,
-            {"delta": new_delta},
+            pipe_wrap({"delta": new_delta}, ctx),
             {"comm_bytes": jnp.asarray(comm_tx, jnp.float32),
              "comm_recv_bytes": jnp.asarray(
                  comm_tx * (ctx.num_nodes - 1), jnp.float32)},

@@ -31,7 +31,7 @@ from .base import PyTree, tree_bytes
 from .communicate_optimize import (CommunicateOptimizeStrategy,
                                    CommunicationModule)
 from .optim import OptimSpec, ensure_optim_spec
-from .sharding import take_shard, unshard
+from .sharding import pipe_unwrap, pipe_wrap, take_shard, unshard
 
 
 class DiLoCoCommunicator(CommunicationModule):
@@ -88,14 +88,18 @@ class DiLoCoCommunicator(CommunicationModule):
         # the node index is live and each node keeps only its own slice.
         # Dtype follows the params (sharding.take_shard), so the sharded
         # Nesterov arithmetic is comparable with the replicated path for
-        # any parameter dtype.
+        # any parameter dtype. Under pipeline parallelism the slice covers
+        # THIS STAGE's param view — pipe-varying (sharding.pipe_wrap).
         my, _, _ = take_shard(params, self._ctx.num_nodes,
                               self._ctx.node_index())
-        return {"master": my, "outer_opt": self.outer_tx.init(my)}
+        return pipe_wrap({"master": my, "outer_opt": self.outer_tx.init(my)},
+                         self._ctx)
 
     def communicate(self, params, mstate, step, ctx):
         k = ctx.num_nodes
         psize = float(tree_bytes(params))
+        if self.shard_outer:
+            mstate = pipe_unwrap(mstate, ctx)
 
         def _avg_and_alive(params):
             """Round average + this node's participation flag. With
@@ -157,7 +161,10 @@ class DiLoCoCommunicator(CommunicationModule):
 
         outer = outer_sharded if self.shard_outer else outer_replicated
         do = jnp.logical_and(step % self.H == 0, step > 0)
-        return jax.lax.cond(do, outer, skip, params, mstate)
+        params, mstate, comm = jax.lax.cond(do, outer, skip, params, mstate)
+        if self.shard_outer:
+            mstate = pipe_wrap(mstate, ctx)
+        return params, mstate, comm
 
     def config(self):
         cfg = {"module": "DiLoCoCommunicator", "H": self.H,

@@ -40,7 +40,7 @@ class SimpleReduceStrategy(Strategy):
         # Note the reference runs the reduce even at N=1 (`or True`,
         # strategy.py:129); pmean at K=1 is an identity so behaviour matches.
         grads = ctx.pmean(grads)
-        grads = self._maybe_clip(grads)
+        grads = self._maybe_clip(grads, ctx)
         updates, opt_state = self.tx.update(grads, state["opt"], params)
         params = optax.apply_updates(params, updates)
         k = ctx.num_nodes

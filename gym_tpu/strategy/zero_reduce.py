@@ -37,7 +37,7 @@ from jax.flatten_util import ravel_pytree
 
 from .base import PyTree, Strategy, tree_bytes
 from .optim import OptimSpec, ensure_optim_spec
-from .sharding import shard_size, unshard
+from .sharding import pipe_unwrap, pipe_wrap, shard_size, unshard
 
 
 class ZeroReduceStrategy(Strategy):
@@ -64,13 +64,16 @@ class ZeroReduceStrategy(Strategy):
         )
         shard = jnp.zeros(
             (shard_size(params, self._ctx.num_nodes),), jnp.float32)
-        return {"opt": self.tx.init(shard)}
+        # under pipeline parallelism the flat moments are slices of THIS
+        # STAGE's param view — pipe-varying state (see sharding.pipe_wrap)
+        return pipe_wrap({"opt": self.tx.init(shard)}, self._ctx)
 
     def step(self, grads, params, state, step, ctx):
         # shard size from the step ctx (init's bound ctx must agree — the
         # opt-state shapes pin it, so a mismatched K fails loudly in optax)
         k = ctx.num_nodes
         shard = shard_size(params, k)
+        state = pipe_unwrap(state, ctx)
         flat_g, _ = ravel_pytree(grads)
         flat_p, unravel = ravel_pytree(params)
         pad = k * shard - flat_g.size
@@ -78,7 +81,19 @@ class ZeroReduceStrategy(Strategy):
         flat_p_pad = jnp.pad(flat_p.astype(jnp.float32), (0, pad))
 
         off = ctx.node_index() * shard
-        if len(ctx.axes) == 1 and k > 1:
+        if ctx.pp_axes and self.max_norm:
+            # pipeline + clip: the true global norm spans stages (outer
+            # once, stage parts summed over 'pipe') and cannot be
+            # decomposed from flat chunk norms — mean + pp-aware tree clip
+            # (base._maybe_clip), then slice. Fallback-style comm bytes.
+            gm = ctx.pmean(jax.tree.map(lambda g: g.astype(jnp.float32),
+                                        grads))
+            gm = self._maybe_clip(gm, ctx)
+            fg, _ = ravel_pytree(gm)
+            g_my = lax.dynamic_slice(jnp.pad(fg, (0, pad)), (off,), (shard,))
+            comm = ((k - 1) / max(k, 1)
+                    * (2.0 * tree_bytes(grads) + tree_bytes(params)))
+        elif len(ctx.axes) == 1 and k > 1:
             # canonical ZeRO-1: reduce-scatter the gradient — each node
             # receives only its summed 1/K chunk. Clip semantics identical
             # to the fallback (clip AFTER the mean, by the GLOBAL norm):
@@ -111,6 +126,6 @@ class ZeroReduceStrategy(Strategy):
             unshard(ctx, p_my, flat_p.size, unravel), params)
         return (
             new_params,
-            {"opt": opt_state},
+            pipe_wrap({"opt": opt_state}, ctx),
             {"comm_bytes": jnp.asarray(comm, jnp.float32)},
         )

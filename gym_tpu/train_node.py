@@ -49,7 +49,8 @@ def constrain_params(params: PyTree, param_specs) -> PyTree:
 
 
 def make_init_fn(loss_model: LossModel, strategy: Strategy, example_micro,
-                 seed: int, param_specs=None, ctx: AxisCtx = None):
+                 seed: int, param_specs=None, ctx: AxisCtx = None,
+                 init_params=None):
     """Per-node state init. Params are built from the *same* seed on every
     node — replicas start identical by determinism, replacing the reference's
     initial broadcast from rank 0 (``train_node.py:101-104``). The dropout/
@@ -57,13 +58,22 @@ def make_init_fn(loss_model: LossModel, strategy: Strategy, example_micro,
     nodes.
 
     ``ctx``: pass ``runtime.ctx`` for strategies whose state layout depends
-    on the mesh (ZeRO sharding); harmless otherwise."""
+    on the mesh (ZeRO sharding); harmless otherwise.
+
+    ``init_params``: start from THESE weights instead of the seed init —
+    the analog of the reference training whatever weights the passed
+    ``nn.Module`` instance holds (fine-tuning, ported checkpoints,
+    identical-init comparisons). Tree structure must match the model's."""
     if ctx is not None:
         strategy.bind_ctx(ctx)
 
     def init_fn(node_index: jnp.ndarray) -> TrainState:
         base = jax.random.PRNGKey(seed)
         params, model_state = loss_model.init(base, example_micro)
+        if init_params is not None:
+            params = jax.tree.map(
+                lambda ref, given: jnp.asarray(given, ref.dtype),
+                params, init_params)
         params = constrain_params(params, param_specs)
         return TrainState(
             params=params,
@@ -182,9 +192,25 @@ def make_multi_train_step(loss_model: LossModel, strategy: Strategy,
     return node_multi
 
 
+def _static_index_ctx(ctx: AxisCtx) -> AxisCtx:
+    """Shape-inference twin of an AxisCtx: ``node_index`` pinned to 0 so
+    strategy inits that slice by node index (DiLoCo ``shard_outer``) can
+    be traced OUTSIDE the mesh program (``jax.eval_shape`` for the
+    pipeline state specs), where ``lax.axis_index`` is unbound. State
+    SHAPES don't depend on the index, which is all the shape pass reads."""
+    import dataclasses
+
+    class _Static(type(ctx)):
+        def node_index(self):
+            return jnp.zeros((), jnp.int32)
+
+    return _Static(**dataclasses.asdict(ctx))
+
+
 def make_pipeline_init_fn(pipe_model, strategy: Strategy, example_micro,
                           seed: int, ctx: AxisCtx = None,
-                          static_stage=None, param_specs=None):
+                          static_stage=None, param_specs=None,
+                          init_params=None):
     """Per-node init for the pipelined model (``parallel/pipeline_model``):
     same seed ⇒ same full-model weights as a ``pp=1`` run, each device
     keeping its own stage slice. ``static_stage`` pins the slice for
@@ -193,12 +219,14 @@ def make_pipeline_init_fn(pipe_model, strategy: Strategy, example_micro,
     ``strategy.init`` so the whole state inherits the 'model'-axis layout
     from the start — same contract as ``make_init_fn``."""
     if ctx is not None:
-        strategy.bind_ctx(ctx)
+        strategy.bind_ctx(ctx if static_stage is None
+                          else _static_index_ctx(ctx))
 
     def init_fn(node_index: jnp.ndarray) -> TrainState:
         base = jax.random.PRNGKey(seed)
         params, model_state = pipe_model.init(base, example_micro,
-                                              static_stage=static_stage)
+                                              static_stage=static_stage,
+                                              init_params=init_params)
         params = constrain_params(params, param_specs)
         return TrainState(
             params=params,

@@ -35,7 +35,12 @@ class CheckpointManager:
     (all simulated nodes live in one sharded state).
     """
 
-    def __init__(self, save_dir: str, run_name: str, max_to_keep: int = 1):
+    def __init__(self, save_dir: str, run_name: str, max_to_keep: int = 1,
+                 async_save: bool = True):
+        """``async_save=False`` forces synchronous saves — required in a
+        multi-process world, where Orbax's async finalize (process-0
+        metadata commit after every process's write) races max_to_keep
+        pruning of the tmp dir; the Trainer passes it automatically."""
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
@@ -46,7 +51,7 @@ class CheckpointManager:
             path,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
-                enable_async_checkpointing=True,
+                enable_async_checkpointing=async_save,
                 create=True,
             ),
         )

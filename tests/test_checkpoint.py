@@ -129,3 +129,51 @@ def test_resume_matches_straight_run_pipeline(tmp_path):
     steps = [s for s, _ in resumed.history["train_loss"]]
     assert min(steps) == 3 and max(steps) == 5
     shutil.rmtree(str(tmp_path), ignore_errors=True)
+
+
+def test_cross_topology_restore_pp2_tp2_to_pp1(tmp_path):
+    """Cross-topology restore (VERDICT r3 #6): checkpoints are written in
+    the CANONICAL plain-GPT layout, so a run saved under fit(pp=2, tp=2)
+    resumes at pp=1 with a continuous trajectory. Oracle: a straight
+    pp=1 run 0→6 equals [pp=2×tp=2 run 0→3 → checkpoint → pp=1 resume
+    3→6] to float tolerance (pipelining/sharding are schedules, not
+    algorithm changes — pinned by the pp parity tests)."""
+    import pytest
+
+    from gym_tpu.data.gpt_datasets import ContiguousGPTTrainDataset
+    from gym_tpu.models.nanogpt import GPT, GPTConfig
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices (node=2 x model=2 x pipe=2)")
+
+    rng = np.random.default_rng(8)
+    data = rng.integers(0, 32, 4096, dtype=np.int64)
+    ds = ContiguousGPTTrainDataset(data, block_size=16)
+    cfg = GPTConfig(block_size=16, vocab_size=32, n_layer=4, n_head=2,
+                    n_embd=32, dropout=0.0)
+
+    def fit_any(max_steps, tmp, interval, pp=1, tp=1):
+        return Trainer(GPT(cfg), ds, None).fit(
+            strategy=DiLoCoStrategy(optim_spec=OptimSpec("adamw", lr=1e-3),
+                                    H=3),
+            num_nodes=2, max_steps=max_steps, batch_size=8,
+            minibatch_size=2, pp=pp, tp=tp, val_interval=0,
+            show_progress=False, seed=17, checkpoint_interval=interval,
+            save_dir=tmp, run_name="ckpt_xtopo",
+            log_dir="/tmp/gym_tpu_test_logs",
+        )
+
+    with jax.default_matmul_precision("highest"):
+        straight = fit_any(6, str(tmp_path / "straight"), interval=100)
+        fit_any(3, str(tmp_path / "resume"), interval=3, pp=2, tp=2)
+        resumed = fit_any(6, str(tmp_path / "resume"), interval=3)  # pp=1
+
+    steps = [s for s, _ in resumed.history["train_loss"]]
+    assert min(steps) == 3 and max(steps) == 5  # genuinely resumed
+    losses = [l for _, l in resumed.history["train_loss"]]
+    assert np.all(np.isfinite(losses))
+    for a, b in zip(jax.tree.leaves(straight.params),
+                    jax.tree.leaves(resumed.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-4)
+    shutil.rmtree(str(tmp_path), ignore_errors=True)

@@ -1,0 +1,99 @@
+"""Logger observability: the wandb mirror actually logs when the dep is
+live, and degrades LOUDLY when it is not (VERDICT r3 missing #3 — the
+path existed but was never exercised; a misconfigured project used to die
+silently).
+
+The environment has no wandb (and no egress), so a fake module is
+injected into ``sys.modules``: the real test surface is that
+``Trainer.fit(wandb_project=...)`` wires every stream (train loss + ppl +
+comm bytes, val losses, summary, finish) through whatever ``wandb.init``
+returned.
+"""
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from gym_tpu import Trainer
+from gym_tpu.strategy import OptimSpec, SimpleReduceStrategy
+
+from test_trainer_e2e import TinyLossModel, blobs
+
+
+class _FakeRun:
+    def __init__(self):
+        self.logged = []
+        self.summary_updates = {}
+        self.finished = False
+        self.summary = self
+
+    def log(self, metrics, step=None):
+        self.logged.append((step, dict(metrics)))
+
+    def update(self, d):
+        self.summary_updates.update(d)
+
+    def finish(self):
+        self.finished = True
+
+
+def _install_fake_wandb(monkeypatch, init=None):
+    fake = types.ModuleType("wandb")
+    run = _FakeRun()
+
+    def default_init(project=None, name=None, config=None):
+        fake.init_calls.append(
+            {"project": project, "name": name, "config": config})
+        return run
+
+    fake.init_calls = []
+    fake.init = init or default_init
+    monkeypatch.setitem(sys.modules, "wandb", fake)
+    return fake, run
+
+
+def _fit(**kw):
+    return Trainer(TinyLossModel(), blobs(128), blobs(32)).fit(
+        strategy=SimpleReduceStrategy(OptimSpec("sgd", lr=0.05)),
+        num_nodes=2, max_steps=4, batch_size=16, minibatch_size=16,
+        val_size=16, val_interval=2, show_progress=False,
+        log_dir="/tmp/gym_tpu_test_logs", **kw,
+    )
+
+
+def test_wandb_logger_logs_all_streams(monkeypatch):
+    fake, run = _install_fake_wandb(monkeypatch)
+    res = _fit(wandb_project="gym-tpu-test", run_name="wb")
+
+    assert np.isfinite(res.final_train_loss)
+    assert fake.init_calls == [{
+        "project": "gym-tpu-test", "name": "wb",
+        "config": fake.init_calls[0]["config"]}]
+    cfg = fake.init_calls[0]["config"]
+    assert cfg["strategy"] == "SimpleReduceStrategy"
+    assert cfg["num_nodes"] == 2
+
+    keys = set()
+    for _, metrics in run.logged:
+        keys.update(metrics)
+    # train stream (loss, ppl, lr, comm) and the local/global val streams
+    assert {"train/loss", "train/perplexity", "lr",
+            "comm/bytes_step", "comm/bytes_cum"} <= keys
+    assert {"local/loss", "global/loss"} <= keys
+    # per-step train logging actually fired once per step
+    train_steps = [s for s, m in run.logged if "train/loss" in m]
+    assert len(train_steps) == 4
+    assert "final_train_loss" in run.summary_updates
+    assert run.finished
+
+
+def test_wandb_misconfigured_warns_and_degrades(monkeypatch):
+    def bad_init(project=None, name=None, config=None):
+        raise RuntimeError("api_key not configured")
+
+    _install_fake_wandb(monkeypatch, init=bad_init)
+    with pytest.warns(UserWarning, match="wandb logging disabled"):
+        res = _fit(wandb_project="nope")
+    assert np.isfinite(res.final_train_loss)

@@ -21,7 +21,7 @@ M = 6          # microbatches
 DIM = 16
 
 
-def _layer_fn(p, x):
+def _layer_fn(p, x, li=0):
     return jnp.tanh(x @ p["w"] + p["b"])
 
 
@@ -54,7 +54,10 @@ def _pipelined(per_layer, xs):
     )
     def run(stage_params, xs):
         stage_params = take_stage(stage_params)
-        fn = functools.partial(apply_stage_layers, _layer_fn)
+
+        def fn(sp, x, m_idx):
+            return apply_stage_layers(_layer_fn, sp, x)
+
         return pipeline_apply(fn, stage_params, xs, S)
 
     return run, stacked, xs
@@ -117,7 +120,7 @@ def test_pipeline_gpt_trunk_matches_plain_forward():
     n_stages = 2
     block = Block(cfg)
 
-    def layer_fn(layer_params, x):
+    def layer_fn(layer_params, x, li=0):
         return block.apply({"params": layer_params}, x, False)
 
     per_layer = [params[f"h_{i}"] for i in range(cfg.n_layer)]
@@ -144,7 +147,10 @@ def test_pipeline_gpt_trunk_matches_plain_forward():
     def run(stage_params, idx):
         stage_params = take_stage(stage_params)
         xs = jax.vmap(embed)(idx)
-        fn = functools.partial(apply_stage_layers, layer_fn)
+
+        def fn(sp, x, m_idx):
+            return apply_stage_layers(layer_fn, sp, x)
+
         hs = pipeline_apply(fn, stage_params, xs, n_stages)
         return jax.vmap(head)(hs)
 
@@ -158,7 +164,8 @@ def test_pipeline_gpt_trunk_matches_plain_forward():
 
 
 def _pp_fit(pp, num_nodes=2, n_layer=4, max_steps=6, dataset=None,
-            H=3, lr=1e-3, strategy=None, **fit_kwargs):
+            H=3, lr=1e-3, strategy=None, dropout=0.0, moe=False,
+            **fit_kwargs):
     from gym_tpu.data.gpt_datasets import ContiguousGPTTrainDataset
     from gym_tpu.models.nanogpt import GPT, GPTConfig
     from gym_tpu.strategy.diloco import DiLoCoStrategy
@@ -176,8 +183,18 @@ def _pp_fit(pp, num_nodes=2, n_layer=4, max_steps=6, dataset=None,
     def factory(rank, nn_, is_val):
         return dataset
 
+    moe_kw = {}
+    if moe:
+        # capacity high enough that the EP 'einsum' dispatch never drops
+        # a token — then all three dispatch impls are the same math and
+        # sharded runs can be pinned against unsharded ones exactly
+        moe_kw = dict(n_experts=4, expert_topk=2, moe_every=2,
+                      capacity_factor=4.0,
+                      expert_axis="expert" if fit_kwargs.get("ep", 1) > 1
+                      else None)
     cfg = GPTConfig(block_size=dataset.block_size, vocab_size=vocab,
-                    n_layer=n_layer, n_head=2, n_embd=32, dropout=0.0)
+                    n_layer=n_layer, n_head=2, n_embd=32, dropout=dropout,
+                    **moe_kw)
     return Trainer(GPT(cfg), factory, factory).fit(
         num_nodes=num_nodes,
         strategy=strategy or DiLoCoStrategy(OptimSpec("adamw", lr=lr), H=H),
@@ -252,19 +269,97 @@ def test_fit_pp_trains_on_real_data():
     assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
 
 
-def test_fit_pp_rejects_flat_layout_strategies():
-    import pytest
-    from gym_tpu.strategy.diloco import DiLoCoStrategy
+def test_fit_pp2_zero_matches_pp1():
+    """pp x ZeRO-1 (VERDICT r3 #2): the sharded-optimizer strategy under
+    pipeline parallelism — each (node, stage) device ravels its OWN local
+    view (outer + stage slice; state marked pipe-varying via pipe_wrap) —
+    must reproduce the pp=1 ZeRO run exactly: Adam is elementwise, so the
+    flat partitioning cannot change the math. max_norm is set low enough
+    that clipping ACTIVELY fires, pinning the pp-aware global-norm path
+    (a per-stage norm would desync the tied embeddings)."""
     from gym_tpu.strategy.optim import OptimSpec
     from gym_tpu.strategy.zero_reduce import ZeroReduceStrategy
 
-    with pytest.raises(ValueError, match="tree-mapped"):
-        _pp_fit(pp=2, strategy=ZeroReduceStrategy(OptimSpec("adamw")))
-    # DiLoCo's sharded outer master is a flat per-node vector too: under
-    # pp it would slice each device's own stage view — refuse it
-    with pytest.raises(ValueError, match="tree-mapped"):
-        _pp_fit(pp=2, strategy=DiLoCoStrategy(OptimSpec("adamw"), H=2,
-                                              shard_outer=True))
+    def strat():
+        return ZeroReduceStrategy(OptimSpec("adamw", lr=1e-3),
+                                  max_norm=0.05)
+
+    with jax.default_matmul_precision("highest"):
+        r1 = _pp_fit(pp=1, strategy=strat())
+        r2 = _pp_fit(pp=2, strategy=strat())
+    for key in ("train_loss", "global_loss"):
+        a = [l for _, l in r1.history[key]]
+        b = [l for _, l in r2.history[key]]
+        np.testing.assert_allclose(b, a, rtol=2e-4, atol=1e-5)
+
+
+def test_fit_pp2_clip_matches_pp1():
+    """The pp-aware global-norm clip (base._maybe_clip): with max_norm
+    low enough to always fire, pp=2 must match pp=1 — a per-device norm
+    would scale each stage differently and desync the replicated outer
+    params (embeddings/tied head) across the pipe group."""
+    from gym_tpu.strategy.optim import OptimSpec
+    from gym_tpu.strategy.simple_reduce import SimpleReduceStrategy
+
+    def strat():
+        return SimpleReduceStrategy(OptimSpec("adamw", lr=3e-3),
+                                    max_norm=0.05)
+
+    with jax.default_matmul_precision("highest"):
+        r1 = _pp_fit(pp=1, strategy=strat())
+        r2 = _pp_fit(pp=2, strategy=strat())
+    a = [l for _, l in r1.history["train_loss"]]
+    b = [l for _, l in r2.history["train_loss"]]
+    np.testing.assert_allclose(b, a, rtol=2e-4, atol=1e-5)
+
+
+def test_fit_pp2_diloco_shard_outer_matches_replicated():
+    """pp x DiLoCo(shard_outer=True): the flat sharded outer master under
+    pp slices each stage's own view — must equal the replicated-outer run
+    at pp=2 AND the pp=1 run exactly (Nesterov is elementwise)."""
+    from gym_tpu.strategy.diloco import DiLoCoStrategy
+    from gym_tpu.strategy.optim import OptimSpec
+
+    def strat(shard_outer):
+        return DiLoCoStrategy(OptimSpec("adamw", lr=1e-3), H=2,
+                              shard_outer=shard_outer)
+
+    with jax.default_matmul_precision("highest"):
+        r_ref = _pp_fit(pp=1, strategy=strat(False))
+        r_rep = _pp_fit(pp=2, strategy=strat(False))
+        r_sh = _pp_fit(pp=2, strategy=strat(True))
+    ref = [l for _, l in r_ref.history["train_loss"]]
+    rep = [l for _, l in r_rep.history["train_loss"]]
+    sh = [l for _, l in r_sh.history["train_loss"]]
+    np.testing.assert_allclose(rep, ref, rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(sh, rep, rtol=2e-4, atol=1e-5)
+
+
+def test_fit_pp2_demo_trains_with_stage_local_state():
+    """pp x DeMo: the pooled DCT residuals chunk each stage's own param
+    view (chunk boundaries follow the pipeline layout, so the trajectory
+    is a different — equally valid — instance of the compression than
+    pp=1; exact parity is not expected). Pinned instead: it trains, and
+    the pipe-wrapped residual state is genuinely STAGE-VARYING — the
+    silent failure mode without pipe_wrap is the stages' residuals being
+    collapsed to one stage's copy."""
+    from gym_tpu.strategy.demo import DeMoStrategy
+
+    res = _pp_fit(pp=2, num_nodes=2, max_steps=20,
+                  strategy=DeMoStrategy(compression_chunk=16,
+                                        compression_topk=4))
+    losses = [l for _, l in res.history["train_loss"]]
+    assert np.all(np.isfinite(losses))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
+
+    delta = res.node_state.strategy_state["pipe_local"]["delta"]
+    varying = False
+    for leaf in jax.tree.leaves(delta):
+        g = np.asarray(leaf)          # [K, S, ...]
+        assert g.shape[1] == 2
+        if np.any(g[:, 0] != g[:, 1]):
+            varying = True
+    assert varying, "stage residuals identical: pipe state collapsed"
 
 
 def test_fit_pp_multi_step_dispatch_and_autocast():
@@ -320,6 +415,69 @@ def test_fit_pp_composes_with_partial_participation():
     full = [l for _, l in run(1.0).history["train_loss"]]
     assert losses[:2] == full[:2]          # identical until the round
     assert any(abs(a - b) > 1e-7 for a, b in zip(losses[3:], full[3:]))
+
+
+def test_fit_pp2_dropout_trains():
+    """VERDICT r3 #5: fit(pp=K, dropout>0) trains — per-tick dropout rng
+    folded per (stage-global layer, microbatch) through the GPipe scan.
+    Eval runs dropout-off (deterministic), so the eval stream is finite
+    and the run converges; the dropout=0 path is byte-identical to before
+    (pinned by the pp=2 == pp=1 parity tests above)."""
+    from gym_tpu.data.build_dataset import get_dataset
+    from gym_tpu.strategy.optim import OptimSpec
+    from gym_tpu.strategy.simple_reduce import SimpleReduceStrategy
+
+    # real-English corpus: random-token data is born converged at ln(V),
+    # leaving nothing for the falling-loss assertion to measure
+    ds, vocab = get_dataset("docs", block_size=64, end_pc=0.1)
+    res = _pp_fit(pp=2, max_steps=30, dropout=0.1, dataset=(ds, vocab),
+                  strategy=SimpleReduceStrategy(OptimSpec("adamw",
+                                                          lr=3e-3)))
+    losses = [l for _, l in res.history["train_loss"]]
+    assert np.all(np.isfinite(losses))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
+    assert all(np.isfinite(v) for _, v in res.history["global_loss"])
+
+
+def test_fit_pp2_moe_matches_pp1():
+    """pp x MoE (VERDICT r3 #2): mixed dense/MoE trunk through GPipe
+    stages — dense and MoE layers stacked as separate groups, router aux
+    summed per stage over valid ticks and psum'd over 'pipe'. Must equal
+    the pp=1 MoE run exactly (same drop-free dispatch, schedule only)."""
+    with jax.default_matmul_precision("highest"):
+        r1 = _pp_fit(pp=1, moe=True)
+        r2 = _pp_fit(pp=2, moe=True)
+    for key in ("train_loss", "global_loss"):
+        a = [l for _, l in r1.history[key]]
+        b = [l for _, l in r2.history[key]]
+        np.testing.assert_allclose(b, a, rtol=3e-4, atol=2e-5)
+
+
+def test_fit_pp2_ep2_matches_unsharded():
+    """pp x ep: a ('node','expert','pipe') mesh — GPipe stages manual
+    over 'pipe' while the GSPMD-auto 'expert' axis shards each stage's
+    expert-stacked MoE params (moe_param_specs leading=2). At a capacity
+    where nothing drops, the einsum dispatch equals the unsharded
+    drop-free run exactly."""
+    if len(jax.devices()) < 8:
+        import pytest
+        pytest.skip("needs 8 devices")
+    with jax.default_matmul_precision("highest"):
+        r0 = _pp_fit(pp=1, moe=True)
+        r = _pp_fit(pp=2, ep=2, moe=True)
+    for key in ("train_loss", "global_loss"):
+        a = [l for _, l in r0.history[key]]
+        b = [l for _, l in r.history[key]]
+        np.testing.assert_allclose(b, a, rtol=3e-4, atol=2e-5)
+
+
+def test_fit_pp_rejects_stage_misaligned_moe():
+    """pp=4 x n_layer=4 x moe_every=2 would give stages different layer
+    patterns (the stage program is one SPMD function) — loud refusal."""
+    import pytest
+
+    with pytest.raises(ValueError, match="moe_every"):
+        _pp_fit(pp=4, moe=True, num_nodes=2)
 
 
 def test_fit_pp2_tp2_matches_unsharded():
