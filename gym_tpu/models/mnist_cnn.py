@@ -23,7 +23,13 @@ class CNN(nn.Module):
         def block(x, feat):
             for _ in range(2):
                 x = nn.Conv(feat, (3, 3), padding="SAME")(x)
-                x = nn.BatchNorm(use_running_average=not train)(x)
+                # momentum 0.9 = torch BatchNorm2d's default running-stat
+                # decay (torch momentum 0.1 ⇒ new = 0.9·old + 0.1·batch);
+                # flax's default 0.99 tracked much staler stats and showed
+                # up as a systematic eval-loss gap in the identical-init
+                # head-to-head vs the torch reference
+                x = nn.BatchNorm(use_running_average=not train,
+                                 momentum=0.9)(x)
                 x = nn.relu(x)
             x = nn.max_pool(x, (2, 2), strides=(2, 2))
             # torch Dropout2d zeroes whole channels: broadcast over H, W.

@@ -65,7 +65,9 @@ def initialize(
     return True
 
 
-_NODE_MAP_CACHE: dict = {}
+import weakref
+
+_NODE_MAP_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
 def _local_node_map(mesh, process_index: Optional[int] = None):
@@ -73,7 +75,8 @@ def _local_node_map(mesh, process_index: Optional[int] = None):
     ``(local_devs, coord, row_of, n_local_coords)``. The argwhere scans
     are O(local_devs × mesh_size) on a host object array — cached per
     (mesh, process) so the per-step ``global_batch`` path never
-    recomputes them (the map is fixed for a mesh's lifetime)."""
+    recomputes them (the map is fixed for a mesh's lifetime). Weak-keyed
+    so repeated fits in one process don't pin dead meshes alive."""
     import numpy as np
 
     mesh_devs = list(mesh.devices.flat)
@@ -83,10 +86,9 @@ def _local_node_map(mesh, process_index: Optional[int] = None):
         # (e.g. a single-process TPU plugin alongside a multi-process CPU
         # world) and then reports 0 in every process
         process_index = mesh_devs[0].client.process_index()
-    key = (id(mesh), process_index)
-    hit = _NODE_MAP_CACHE.get(key)
-    if hit is not None and hit[0] is mesh:
-        return hit[1]
+    per_mesh = _NODE_MAP_CACHE.get(mesh)
+    if per_mesh is not None and process_index in per_mesh:
+        return per_mesh[process_index]
     mesh_arr = mesh.devices
     local_devs = [d for d in mesh_devs if d.process_index == process_index]
     assert local_devs, f"process {process_index} owns no mesh devices"
@@ -99,7 +101,7 @@ def _local_node_map(mesh, process_index: Optional[int] = None):
     local_coords = sorted(set(coord.values()))
     row_of = {c: i for i, c in enumerate(local_coords)}
     out = (local_devs, coord, row_of, len(local_coords))
-    _NODE_MAP_CACHE[key] = (mesh, out)  # keep mesh alive ⇒ id() stays valid
+    _NODE_MAP_CACHE.setdefault(mesh, {})[process_index] = out
     return out
 
 

@@ -261,6 +261,11 @@ def make_pipeline_train_step(pipe_model, strategy: Strategy, ctx: AxisCtx,
             state = state.replace(
                 params=constrain_params(state.params, param_specs))
         step_rng = jax.random.fold_in(state.rng, state.step)
+        if ctx.seq_axes:
+            # decorrelate dropout across a node's sequence chunks (same
+            # contract as make_train_step — without it, pp×cp×dropout
+            # would draw identical masks on every chunk)
+            step_rng = jax.random.fold_in(step_rng, ctx.seq_index())
 
         def loss_fn(params):
             # the LOCAL masked loss: single-source gradient seed (see

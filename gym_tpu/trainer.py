@@ -245,12 +245,12 @@ class Trainer:
             from .parallel import multihost
             my_proc = mesh_devs[0].client.process_index()
             primary = my_proc == 0
-            arr = runtime.mesh.devices
-            coords = sorted({int(np.argwhere(arr == d)[0][0])
-                             for d in mesh_devs
-                             if d.process_index == my_proc})
+            # single source of truth with global_batch's row mapping:
+            # row_of's keys are this process's sorted node coordinates
+            _, _, row_of, _ = multihost._local_node_map(runtime.mesh,
+                                                        my_proc)
             # node-axis coordinate c carries simulated nodes [cV, (c+1)V)
-            local_nodes = [c * runtime.n_virt + j for c in coords
+            local_nodes = [c * runtime.n_virt + j for c in sorted(row_of)
                            for j in range(runtime.n_virt)]
             # identity jit with replicated out_shardings = one all-gather:
             # makes tiny metric arrays fully addressable on every host
@@ -279,7 +279,9 @@ class Trainer:
             # ragged on physical-node programs, dense under vnode folding.
             pinned = ("einsum" if (ep > 1 or mod_cfg.expert_axis)
                       else "dense" if runtime.n_virt > 1 else "ragged")
-            loss_model = LossModel(
+            # type(loss_model): preserve a user LossModel subclass (its
+            # overridden loss() must keep training the run)
+            loss_model = type(loss_model)(
                 _GPT(dataclasses.replace(mod_cfg, moe_impl=pinned)),
                 loss_model.compute_dtype)
         pipe_model = None

@@ -21,6 +21,12 @@ def main() -> None:
 
     import jax
 
+    # sitecustomize forces jax_platforms='axon,cpu' over the env var, and
+    # ANY backend touch (even jax.devices("cpu")) initializes the whole
+    # platform list — hanging forever if the axon tunnel is down. Pin the
+    # multi-process CPU world as the only platform (the pod analog).
+    jax.config.update("jax_platforms", "cpu")
+
     from gym_tpu.parallel import multihost
 
     assert multihost.initialize(
