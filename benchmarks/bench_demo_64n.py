@@ -64,25 +64,34 @@ def main():
         segment_bytes=args.segment_bytes,
         delta_dtype=jnp.bfloat16 if args.delta_bf16 else None,
     )
+    def one_fit(steps, **kw):
+        t0 = time.time()
+        res = Trainer(GPT(cfg), ds, None).fit(
+            strategy=strat, num_nodes=args.nodes, max_steps=steps,
+            batch_size=args.batch_size, minibatch_size=args.batch_size,
+            autocast=True, val_size=0, val_interval=0,
+            steps_per_call=args.steps_per_call, device=args.device,
+            show_progress=False, log_dir="/tmp/demo64_logs", **kw,
+        )
+        return res, time.time() - t0
+
     kw = {}
     if args.profile:
         os.system(f"rm -rf {args.profile_dir}")
         kw["profile_dir"] = args.profile_dir
 
-    t0 = time.time()
-    res = Trainer(GPT(cfg), ds, None).fit(
-        strategy=strat, num_nodes=args.nodes, max_steps=args.steps,
-        batch_size=args.batch_size, minibatch_size=args.batch_size,
-        autocast=True, val_size=0, val_interval=0,
-        steps_per_call=args.steps_per_call, device=args.device,
-        show_progress=False, log_dir="/tmp/demo64_logs", **kw,
-    )
-    wall = time.time() - t0
-    # steady-state: fit's own steps_per_second includes compile; report
-    # both and a tail-rate estimate from re-running a short second fit
+    # each fit builds fresh jitted closures, so any single fit's wall
+    # time includes a full compile — steady-state it/s is taken as the
+    # two-fit DIFFERENCE (identical programs compile in both fits, so
+    # the compile term cancels)
+    short = max(2, args.steps // 4)
+    _, t_short = one_fit(short)
+    res, t_long = one_fit(args.steps, **kw)
+    tail_s_per_step = (t_long - t_short) / (args.steps - short)
     print(json.dumps({
+        "it_s_steady": round(1.0 / tail_s_per_step, 3),
         "it_s_incl_compile": round(res.steps_per_second, 3),
-        "wall_s": round(wall, 1),
+        "wall_s": round(t_long, 1),
         "final_loss": round(float(res.final_train_loss), 4),
         "steps": args.steps,
     }), flush=True)

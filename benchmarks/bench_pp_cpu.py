@@ -13,12 +13,20 @@ so on time-shared devices
 
 (the GPipe bubble fraction). A defective schedule — per-tick re-dispatch,
 serialization overhead, an accidental S× tick count — would exceed the
-law, and the law's M-dependence (ratio falling toward 1 as M grows) is
-the signature that the bubble, not a fixed overhead, is what remains.
+law.
 
-Usage: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-           python benchmarks/bench_pp_cpu.py [--steps 12]
-Prints one JSON line per (pp, M) config plus the predicted ratios.
+Timing method: each Trainer.fit builds fresh jitted closures, so ANY
+single fit's wall time includes a full XLA compile (larger for the pp=2
+scan program, which would contaminate the ratio). Per-step cost is
+therefore taken as the DIFFERENCE of two fits in the same process with
+different step counts — identical programs compile in both, so the
+compile term cancels: s/step = (t(N_long) − t(N_short)) / (N_long −
+N_short).
+
+Usage: python benchmarks/bench_pp_cpu.py [--steps 16] [--n_layer 4]
+           [--out PATH]
+Prints one JSON line per M plus the predicted ratio. The committed
+`logs/pp_cpu_schedule.json` rows come from --n_layer 4 and --n_layer 8.
 """
 
 from __future__ import annotations
@@ -32,16 +40,14 @@ import time
 REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
 sys.path.insert(0, REPO)
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=8 "
                            + os.environ.get("XLA_FLAGS", ""))
 
 import numpy as np
 
 
-def run(pp: int, n_micro: int, steps: int):
-    """Steady-state seconds/step of the pipelined (or plain) train step,
-    timed over jitted dispatches with a value fetch as the fence."""
+def fit_time(pp: int, n_micro: int, steps: int, n_layer: int) -> float:
+    """Wall seconds of one full fit (compile + steps) at the config."""
     import jax
 
     from gym_tpu.data.gpt_datasets import ContiguousGPTTrainDataset
@@ -53,48 +59,45 @@ def run(pp: int, n_micro: int, steps: int):
     rng = np.random.default_rng(0)
     data = rng.integers(0, 64, 262144, dtype=np.int64)
     ds = ContiguousGPTTrainDataset(data, block_size=256)
+    cfg = GPTConfig(block_size=256, vocab_size=64, n_layer=n_layer,
+                    n_head=4, n_embd=256, dropout=0.0)
+    t0 = time.time()
+    Trainer(GPT(cfg), ds, None).fit(
+        strategy=SimpleReduceStrategy(OptimSpec("adamw", lr=1e-3)),
+        num_nodes=2, max_steps=steps, batch_size=4 * n_micro,
+        minibatch_size=4, val_size=0, val_interval=0, pp=pp,
+        device="cpu", show_progress=False,
+        log_dir="/tmp/gym_tpu_pp_bench_logs",
+    )
+    return time.time() - t0
 
-    # big enough that stage compute dominates host dispatch on the
-    # single-core CPU mesh (at 128-dim shapes the per-step host overhead
-    # swamped the schedule and the ratios measured noise)
-    cfg = GPTConfig(block_size=256, vocab_size=64, n_layer=4, n_head=4,
-                    n_embd=256, dropout=0.0)
-    # warmup fold: run a couple of steps inside fit, then time the rest
-    t0 = time.time()
-    res = Trainer(GPT(cfg), ds, None).fit(
-        strategy=SimpleReduceStrategy(OptimSpec("adamw", lr=1e-3)),
-        num_nodes=2, max_steps=steps, batch_size=4 * n_micro,
-        minibatch_size=4, val_size=0, val_interval=0, pp=pp,
-        device="cpu", show_progress=False,
-        log_dir="/tmp/gym_tpu_pp_bench_logs",
-    )
-    # fit's steps_per_second covers the whole loop incl. compile; redo a
-    # timed tail by fitting twice and subtracting would be noisy — use
-    # the second fit (warm persistent compilation cache within process)
-    t0 = time.time()
-    res = Trainer(GPT(cfg), ds, None).fit(
-        strategy=SimpleReduceStrategy(OptimSpec("adamw", lr=1e-3)),
-        num_nodes=2, max_steps=steps, batch_size=4 * n_micro,
-        minibatch_size=4, val_size=0, val_interval=0, pp=pp,
-        device="cpu", show_progress=False,
-        log_dir="/tmp/gym_tpu_pp_bench_logs",
-    )
-    dt = (time.time() - t0) / steps
-    return dt
+
+def s_per_step(pp: int, n_micro: int, steps: int, n_layer: int) -> float:
+    """Compile-cancelled steady-state s/step (two-fit difference)."""
+    short = max(2, steps // 4)
+    t_short = fit_time(pp, n_micro, short, n_layer)
+    t_long = fit_time(pp, n_micro, steps, n_layer)
+    return (t_long - t_short) / (steps - short)
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--steps", type=int, default=16)
+    ap.add_argument("--n_layer", type=int, default=4)
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # never touch accelerators
+
     rows = []
     for n_micro in (2, 4, 8):
-        t1 = run(1, n_micro, args.steps)
-        t2 = run(2, n_micro, args.steps)
+        t1 = s_per_step(1, n_micro, args.steps, args.n_layer)
+        t2 = s_per_step(2, n_micro, args.steps, args.n_layer)
         predicted = (n_micro + 1) / n_micro  # (M + S − 1) / M at S=2
         rows.append({
+            "n_layer": args.n_layer,
             "M": n_micro,
             "pp1_s_per_step": round(t1, 4),
             "pp2_s_per_step": round(t2, 4),

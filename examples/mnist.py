@@ -97,6 +97,12 @@ def main():
     p.add_argument("--wandb_project", default=None)
     args = p.parse_args()
 
+    if args.device == "cpu":
+        # pin the platform LIST (see examples/nanogpt.py): initializing
+        # a dead accelerator plugin first would hang forever
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
     trainer = Trainer(MnistLossModel(), load_mnist(True), load_mnist(False))
     res = trainer.fit(
         num_epochs=args.num_epochs,

@@ -188,6 +188,13 @@ def main():
                         "node-averaged model (KV-cache decoder)")
     args = p.parse_args()
 
+    if args.device == "cpu":
+        # pin the platform LIST, not just the device choice: initializing
+        # the full list (this host forces an accelerator plugin first)
+        # hangs forever when the accelerator transport is down
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
     attn = args.attn_impl or ("ring" if args.cp > 1 else "dense")
 
     # dataset factory: per-node OWT shard convention

@@ -175,6 +175,57 @@ class DeMoStrategy(Strategy):
             return jnp.asarray(base, jnp.float32)
         return base * self._lr_scale(step)
 
+    def _exchange_decode(self, payload, n_chunks: int, k: int, a: int,
+                         b: int, ctx, decode_one):
+        """One packed exchange + decode per signature.
+
+        vnode path (round 4): the decode of the gathered picks is node-
+        IDENTICAL, so under vnode folding the vmapped program used to
+        both materialize the full [K, G, 2k] gathered payload AND run
+        the whole decode once per virtual node — V-fold redundancy on
+        one device. Now the chunk rows are sharded over the *vnode* axis
+        BEFORE the exchange: a tiled ``all_to_all`` over 'vnode' hands
+        lane j every virtual node's picks for its own row slice (then an
+        ``all_gather`` over the physical node axes adds the other
+        devices' picks), the lane decodes G/V rows, and an intra-device
+        ``all_gather`` over 'vnode' reassembles the sign. Pure
+        reordering — per-chunk computations and scatter-mean semantics
+        are unchanged (order-invariant sums), so the result matches the
+        replicated decode; network bytes and ``comm_bytes`` accounting
+        are untouched (the vnode axis is device-local; physical axes
+        still see one payload gather). On pure physical meshes
+        (n_virt == 1) the original single all_gather path runs."""
+        from jax import lax
+
+        from ..parallel.axis import VNODE_AXIS
+
+        v = dict(zip(ctx.axes, ctx.sizes)).get(VNODE_AXIS, 1)
+        sharded = v > 1 and n_chunks >= v
+        if sharded:
+            rows = -(-n_chunks // v)
+            p = jnp.pad(payload, ((0, v * rows - n_chunks), (0, 0)))
+            p = lax.all_to_all(p, VNODE_AXIS, split_axis=0,
+                               concat_axis=0, tiled=True)
+            p = p.reshape(v, rows, 2 * k)
+            for ax in reversed([x for x in ctx.axes if x != VNODE_AXIS]):
+                p = lax.all_gather(p, ax, tiled=False)
+            gathered = p.reshape(-1, rows, 2 * k)       # [K, rows, 2k]
+        else:
+            rows = n_chunks
+            gathered = ctx.all_gather(payload)          # [K, G, 2k]
+        k_nodes = gathered.shape[0]
+        g_val = gathered[..., :k]
+        g_idx = lax.bitcast_convert_type(gathered[..., k:], jnp.int32)
+        # [K, rows, k] → [rows, K·k]: concat every node's picks per chunk
+        all_val = jnp.moveaxis(g_val, 0, -2).reshape(rows, k_nodes * k)
+        all_idx = jnp.moveaxis(g_idx, 0, -2).reshape(rows, k_nodes * k)
+        part = _segmented(decode_one, rows, self._n_segments(rows, a, b),
+                          all_idx, all_val)
+        if not sharded:
+            return part
+        full = lax.all_gather(part, VNODE_AXIS, tiled=False)  # [v, rows, ·]
+        return full.reshape(v * rows, -1)[:n_chunks]
+
     def step(self, grads, params, state, step, ctx):
         grads = self._maybe_clip(grads, ctx)
         state = pipe_unwrap(state, ctx)
@@ -232,28 +283,22 @@ class DeMoStrategy(Strategy):
                 encode_one, n_chunks, n_seg, state["delta"][key], g_cat)
             k = idx.shape[-1]
             # exchange: (val, idx-bitcast) packed into ONE f32 payload →
-            # one all_gather per signature regardless of model depth
+            # one exchange per signature regardless of model depth
             payload = jnp.concatenate(
                 [val.astype(jnp.float32),
                  jax.lax.bitcast_convert_type(idx, jnp.float32)], axis=-1
             )
-            gathered = ctx.all_gather(payload)     # [K, G, 2k]
-            k_nodes = gathered.shape[0]
-            g_val = gathered[..., :k]
-            g_idx = jax.lax.bitcast_convert_type(gathered[..., k:], jnp.int32)
-            # [K, G, k] → [G, K·k]: concat every node's picks per chunk
-            all_val = jnp.moveaxis(g_val, 0, -2).reshape(idx.shape[0],
-                                                         k_nodes * k)
-            all_idx = jnp.moveaxis(g_idx, 0, -2).reshape(idx.shape[0],
-                                                         k_nodes * k)
+
             # Concatenated picks may collide across nodes → scatter-MEAN.
             # For modest pick counts the sparse decode (basis-row gather +
             # batched matmul, FLOPs ∝ K·k) beats the dense grid scatter
             # (cost ∝ chunk_elems, K-independent); past the crossover —
             # and past `mean_weights`' O(m²) mask — the dense route wins,
             # e.g. the 64-node configs.
+            n_nodes = ctx.num_nodes
+
             def decode_one(ii, vv):
-                if k_nodes * k <= 128:
+                if n_nodes * k <= 128:
                     w = mean_weights(ii, vv)
                     dec = sparse_decode_chunks(ii, w, d_a, d_b)
                 else:
@@ -263,8 +308,8 @@ class DeMoStrategy(Strategy):
                 # exact in bf16 and halves the resident decode memory
                 return jnp.sign(dec).reshape(-1, a * b).astype(jnp.bfloat16)
 
-            decoded_chunks[key] = _segmented(
-                decode_one, n_chunks, n_seg, all_idx, all_val)
+            decoded_chunks[key] = self._exchange_decode(
+                payload, n_chunks, k, a, b, ctx, decode_one)
             comm_tx += float(idx.shape[0] * k * 8)  # int32 idx + f32 val
 
         # Phase 3 (local): sign-SGD with optional step-weight-decay

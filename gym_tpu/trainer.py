@@ -279,11 +279,13 @@ class Trainer:
             # ragged on physical-node programs, dense under vnode folding.
             pinned = ("einsum" if (ep > 1 or mod_cfg.expert_axis)
                       else "dense" if runtime.n_virt > 1 else "ragged")
-            # type(loss_model): preserve a user LossModel subclass (its
-            # overridden loss() must keep training the run)
-            loss_model = type(loss_model)(
-                _GPT(dataclasses.replace(mod_cfg, moe_impl=pinned)),
-                loss_model.compute_dtype)
+            # shallow-copy + swap the module: preserves a user LossModel
+            # subclass (overridden loss(), extra attributes, any __init__
+            # signature) without re-running its constructor
+            import copy
+            loss_model = copy.copy(loss_model)
+            loss_model.module = _GPT(
+                dataclasses.replace(mod_cfg, moe_impl=pinned))
         pipe_model = None
         if pp > 1:
             # Pipeline parallelism (beyond-reference; VERDICT r2 weak #5
