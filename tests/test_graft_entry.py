@@ -2,10 +2,15 @@
 
 Round 2's `MULTICHIP` artifact went red because a mid-flight libtpu upgrade
 broke the *default* accelerator backend, and the dryrun — a CPU-mesh
-correctness check — let eager ops touch that backend. These tests run
-``dryrun_multichip`` in a subprocess with the default backend deliberately
-poisoned (every non-CPU ``get_backend`` resolution raises, simulating the
-libtpu client/terminal mismatch) and assert the gate stays green.
+correctness check — let eager ops touch that backend.  Round 4's went red
+because an accelerator *site hook* on ``PYTHONPATH`` (a ``sitecustomize``
+that wraps ``xla_bridge``) made ALL backend initialization block — even
+``jax.devices("cpu")`` — which no in-process guard can survive.  These
+tests poison the calling process both ways — a backend that *raises* and a
+site hook that *hangs* — and assert the gate stays green, because
+``dryrun_multichip`` never initializes a backend in the calling process:
+it spawns a sanitized child (``PYTHONPATH`` = repo root only,
+``JAX_PLATFORMS=cpu``, fresh ``XLA_FLAGS``).
 """
 
 import os
@@ -51,3 +56,73 @@ def test_dryrun_multichip_survives_poisoned_default_backend():
         f"dryrun touched the (poisoned) default backend:\n{proc.stderr[-4000:]}"
     )
     assert "DRYRUN_OK_POISONED" in proc.stdout
+
+
+# Simulates /root/.axon_site's failure mode from round 4: a PYTHONPATH
+# sitecustomize whose wrapped backend resolution BLOCKS (the real hook
+# blocked for minutes with ~0 CPU when its transport tunnel was down).
+# Any jax.devices()/get_backend call in a process that loaded this hook
+# hangs; only a process that never loaded it can proceed.
+HANG_SITECUSTOMIZE = """
+import os
+if os.environ.get("GRAFT_POISON_HANG"):
+    import time
+    import jax._src.xla_bridge as xb
+    def _hang(*a, **k):
+        time.sleep(3600)
+    xb.backends = _hang
+    xb._get_backend_uncached = _hang
+    xb._discover_pjrt_plugins = _hang
+"""
+
+HANG_DRIVER = """
+from __graft_entry__ import dryrun_multichip
+dryrun_multichip(4)
+print("DRYRUN_OK_HANGPOISONED")
+"""
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_survives_hanging_site_hook(tmp_path):
+    """A site hook that *blocks* backend init must not take down the gate.
+
+    The raising poison above is routable in-process; a hanging one is not —
+    this asserts the subprocess-sanitization design: the child's PYTHONPATH
+    contains no site hook, so the gate completes while the parent process
+    (which DID load the hook) never touches a backend.
+    """
+    (tmp_path / "sitecustomize.py").write_text(HANG_SITECUSTOMIZE)
+    env = dict(os.environ)
+    # Poison dir first so ITS sitecustomize wins; repo so __graft_entry__
+    # imports. The child must drop both and rebuild PYTHONPATH = repo only.
+    env["PYTHONPATH"] = f"{tmp_path}{os.pathsep}{REPO}"
+    env["GRAFT_POISON_HANG"] = "1"
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", HANG_DRIVER], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, (
+        f"gate died under a hanging site hook:\nstdout:{proc.stdout[-2000:]}"
+        f"\nstderr:{proc.stderr[-4000:]}"
+    )
+    assert "DRYRUN_OK_HANGPOISONED" in proc.stdout
+    # the sanitized child really ran the shapes (diagnostic tail exists)
+    assert "[dryrun] shape 1" in proc.stdout
+
+
+def test_hanging_poison_actually_hangs(tmp_path):
+    """Sanity: the poison sitecustomize really does block jax.devices().
+
+    Without this, the test above could pass vacuously (poison not loading).
+    """
+    (tmp_path / "sitecustomize.py").write_text(HANG_SITECUSTOMIZE)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(tmp_path)
+    env["GRAFT_POISON_HANG"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    with pytest.raises(subprocess.TimeoutExpired):
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices('cpu')"],
+            env=env, capture_output=True, text=True, timeout=25,
+        )
