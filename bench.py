@@ -36,11 +36,14 @@ PROBE_TIMEOUT_S = int(os.environ.get("GYM_TPU_BENCH_PROBE_TIMEOUT", 240))
 # Long: full measurement incl. compiles (~40s) + GPT-2-base rider.
 WATCHDOG_S = int(os.environ.get("GYM_TPU_BENCH_WATCHDOG", 2400))
 
+# Anchored to backend-INIT failure shapes only (the round-4 traceback's
+# "Unable to initialize backend 'axon': ... TPU backend setup/compile
+# error"). A bare "UNAVAILABLE" substring would also match gRPC status
+# lines from a mid-measurement crash on a healthy chip and green a broken
+# bench as chip-absent.
 _UNAVAILABLE_MARKERS = (
     "Unable to initialize backend",
-    "UNAVAILABLE",
     "TPU backend setup",
-    "DEADLINE_EXCEEDED",
     "failed to connect",
 )
 
@@ -81,11 +84,12 @@ def _supervise() -> int:
         try:
             probe = subprocess.run(probe_cmd, capture_output=True, text=True,
                                    timeout=PROBE_TIMEOUT_S)
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as e:
             print(json.dumps(_marker(
                 "tpu_unavailable",
                 f"backend init hung > {PROBE_TIMEOUT_S}s (transport tunnel "
-                "down; site hook blocks all backend init)")))
+                "down; site hook blocks all backend init)",
+                _timeout_tail(e))))
             return 0
         blob = probe.stdout + probe.stderr
         if probe.returncode != 0:

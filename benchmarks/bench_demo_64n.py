@@ -75,18 +75,15 @@ def main():
         )
         return res, time.time() - t0
 
-    kw = {}
-    if args.profile:
-        os.system(f"rm -rf {args.profile_dir}")
-        kw["profile_dir"] = args.profile_dir
-
     # each fit builds fresh jitted closures, so any single fit's wall
     # time includes a full compile — steady-state it/s is taken as the
     # two-fit DIFFERENCE (identical programs compile in both fits, so
-    # the compile term cancels)
+    # the compile term cancels). Neither timing fit carries the profiler
+    # (ADVICE r4: tracer overhead in the long fit alone biased
+    # it_s_steady low); the trace comes from a separate third fit below.
     short = max(2, args.steps // 4)
     _, t_short = one_fit(short)
-    res, t_long = one_fit(args.steps, **kw)
+    res, t_long = one_fit(args.steps)
     tail_s_per_step = (t_long - t_short) / (args.steps - short)
     print(json.dumps({
         "it_s_steady": round(1.0 / tail_s_per_step, 3),
@@ -97,6 +94,9 @@ def main():
     }), flush=True)
 
     if args.profile:
+        import shutil
+        shutil.rmtree(args.profile_dir, ignore_errors=True)
+        one_fit(short, profile_dir=args.profile_dir)
         _print_top_ops(args.profile_dir)
 
 

@@ -59,7 +59,8 @@ def bench_kernel(T, impl, B=4, H=8, D=64, inner=10, iters=4):
         return f"{type(e).__name__}"
 
 
-def bench_ring(T, cp, B=1, H=4, D=32, iters=5, inner=1, dtype="float32"):
+def bench_ring(T, cp, B=1, H=4, D=32, iters=5, inner=1, dtype="float32",
+               layout="contiguous"):
     """``inner`` > 1 chains ring calls inside ONE jit (fori_loop), so
     per-dispatch transport latency (~100 ms on remote tunnels) amortizes
     — required for honest chip timings; CPU-mesh runs are compute-bound
@@ -85,7 +86,8 @@ def bench_ring(T, cp, B=1, H=4, D=32, iters=5, inner=1, dtype="float32"):
     )
 
     def f(q, k, v):
-        return ring_causal_attention(q, k, v, axis_name="seq")
+        return ring_causal_attention(q, k, v, axis_name="seq",
+                                     layout=layout)
 
     # check_vma=False: the kernel-backed block path's pallas out_shapes
     # carry no vma info (same setting as the NodeRuntime programs)
@@ -142,11 +144,18 @@ def main():
             results.append(row)
             print(json.dumps(row), flush=True)
     else:
+        # contiguous vs zig-zag at each (T, cp): the VERDICT r4 #5 claim
+        # is zig-zag ≥1.5× at cp≥2 (every ring step does useful work)
         for T, cp in ((2048, 1), (2048, 8), (8192, 8), (16384, 8),
                       (32768, 8)):
-            ms = bench_ring(T, cp)
-            row = {"T": T, "cp": cp, "ms": ms, "dtype": "float32",
-                   "inner": 1}
+            row = {"T": T, "cp": cp, "dtype": "float32", "inner": 1}
+            row["ms"] = bench_ring(T, cp)
+            if cp > 1:
+                row["ms_zigzag"] = bench_ring(T, cp, layout="zigzag")
+                if isinstance(row["ms"], float) and isinstance(
+                        row["ms_zigzag"], float):
+                    row["zigzag_speedup"] = round(
+                        row["ms"] / row["ms_zigzag"], 2)
             results.append(row)
             print(json.dumps(row), flush=True)
     os.makedirs("logs", exist_ok=True)

@@ -54,8 +54,12 @@ def _is_batched(x) -> bool:
       ``lax.axis_size`` (raises NameError when unbound) — catches the
       simulator's vmap even from inside ``lax.scan`` bodies, where values
       are plain jaxpr tracers, not BatchTracers;
-    - the value's tracer type name — catches direct user vmaps without
-      importing the (private) BatchTracer class.
+    - the value's tracer class — catches direct user vmaps. The class is
+      discovered by a one-time ``eval_shape(vmap(probe))`` feature test
+      (ADVICE r4: matching the private class NAME as a string would break
+      silently on a JAX-internal rename), so whatever class vmap actually
+      uses on this JAX version is what we match; ``eval_shape`` keeps the
+      probe abstract — no backend/device is ever touched.
 
     The Trainer additionally pins ``moe_impl`` from the mesh shape at
     ``fit()`` time (``trainer.py``), so trainer runs never reach this
@@ -66,7 +70,20 @@ def _is_batched(x) -> bool:
         return True
     except NameError:
         pass
-    return type(x).__name__ == "BatchTracer"
+    return isinstance(x, _batch_tracer_cls())
+
+
+_BATCH_TRACER_CLS: Optional[type] = None
+
+
+def _batch_tracer_cls() -> type:
+    global _BATCH_TRACER_CLS
+    if _BATCH_TRACER_CLS is None:
+        seen = []
+        jax.eval_shape(jax.vmap(lambda v: seen.append(type(v)) or v),
+                       jax.ShapeDtypeStruct((1, 1), jnp.float32))
+        _BATCH_TRACER_CLS = seen[0]
+    return _BATCH_TRACER_CLS
 
 
 def _constrain(x, spec):

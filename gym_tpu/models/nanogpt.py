@@ -45,6 +45,14 @@ class GPTConfig:
     # long-context support the reference lacks, SURVEY §5.7).
     attn_impl: str = "dense"
     seq_axis: Optional[str] = None
+    # Context-parallel chunk assignment (parallel/ring_attention.py):
+    # 'zigzag' (default) gives each device half-chunks i and 2cp−1−i so
+    # every ring step does balanced useful work (~2× step time vs
+    # 'contiguous', VERDICT r4 #5); 'contiguous' keeps plain [i·Tl,(i+1)·Tl)
+    # slices. Statically falls back to contiguous when the local chunk
+    # cannot split in half (T/cp odd). Affects compute schedule only —
+    # params, loss, and checkpoints are layout-independent.
+    seq_layout: str = "zigzag"
     # Rematerialize each block in the backward pass: trades ~30% more FLOPs
     # for O(n_layer) less activation memory — the standard TPU lever for
     # fitting GPT-2 base+ shapes (HBM is the bottleneck, MXU has headroom).
@@ -160,6 +168,7 @@ class CausalSelfAttention(nn.Module):
             y = causal_attention(
                 heads(q), heads(k), heads(v),
                 impl=cfg.attn_impl, seq_axis=cfg.seq_axis,
+                seq_layout=cfg.seq_layout,
                 dropout_rate=cfg.dropout, dropout_rng=rng,
                 deterministic=not train,
             )
@@ -303,8 +312,9 @@ class GPT(nn.Module):
             assert cfg.attn_impl == "ring", (
                 f"seq_axis requires attn_impl='ring', got {cfg.attn_impl!r}"
             )
-            idx, targets, pos0 = slice_seq_chunk(idx, targets, cfg.seq_axis)
-            pos = pos0 + jnp.arange(idx.shape[1])[None, :]
+            idx, targets, pos_vec = slice_seq_chunk(
+                idx, targets, cfg.seq_axis, layout=cfg.seq_layout)
+            pos = pos_vec[None, :]
         else:
             pos = jnp.arange(t)[None, :]
         wte = nn.Embed(cfg.vocab_size, cfg.n_embd,
@@ -350,21 +360,44 @@ class GPT(nn.Module):
 # -- model utilities (reference parity helpers) ----------------------------
 
 
-def slice_seq_chunk(idx, targets, seq_axis: str, axis: int = 1):
+def slice_seq_chunk(idx, targets, seq_axis: str, axis: int = 1,
+                    layout: str = "contiguous"):
     """THE context-parallel slicing contract, shared by ``GPT.__call__``
-    and the pipelined loss (``parallel/pipeline_model.py``): this device
-    owns one contiguous token chunk of the ``seq_axis`` group. Returns
-    ``(idx_chunk, targets_chunk, position_offset)``."""
+    and the pipelined loss (``parallel/pipeline_model.py``): every device
+    sees the full batch and slices its own token chunk of the ``seq_axis``
+    group. Returns ``(idx_chunk, targets_chunk, positions)`` where
+    ``positions`` is the [Tl] vector of global token positions the local
+    rows hold.
+
+    ``layout='contiguous'``: chunk ``[i·Tl, (i+1)·Tl)``.
+    ``layout='zigzag'``: half-chunks ``i`` and ``2·sp−1−i`` concatenated —
+    the assignment ``ring_causal_attention(layout='zigzag')`` requires;
+    loss/targets slice identically (CE is permutation-invariant under the
+    psum'd sum/count reduction). Falls back to contiguous when ``Tl`` is
+    odd — the same static condition the attention dispatch tests, so the
+    two sides can never disagree."""
     sp = jax.lax.axis_size(seq_axis)
     t = idx.shape[axis]
     assert t % sp == 0, f"seq len {t} not divisible by cp={sp}"
     tl = t // sp
     chunk = jax.lax.axis_index(seq_axis)
+    if layout == "zigzag" and tl % 2 == 0 and sp > 1:
+        h = tl // 2
+        lo, hi = chunk * h, (2 * sp - 1 - chunk) * h
+
+        def take(z):
+            return jnp.concatenate(
+                [jax.lax.dynamic_slice_in_dim(z, lo, h, axis=axis),
+                 jax.lax.dynamic_slice_in_dim(z, hi, h, axis=axis)],
+                axis=axis)
+
+        pos = jnp.concatenate([lo + jnp.arange(h), hi + jnp.arange(h)])
+        return take(idx), (None if targets is None else take(targets)), pos
     idx = jax.lax.dynamic_slice_in_dim(idx, chunk * tl, tl, axis=axis)
     if targets is not None:
         targets = jax.lax.dynamic_slice_in_dim(targets, chunk * tl, tl,
                                                axis=axis)
-    return idx, targets, chunk * tl
+    return idx, targets, chunk * tl + jnp.arange(tl)
 
 
 def ce_sum_count(x, targets, embedding, loss_chunk: int):

@@ -53,6 +53,7 @@ def causal_attention(
     *,
     impl: str = "dense",
     seq_axis: Optional[str] = None,
+    seq_layout: str = "contiguous",
     dropout_rate: float = 0.0,
     dropout_rng: Optional[jax.Array] = None,
     deterministic: bool = True,
@@ -62,7 +63,8 @@ def causal_attention(
     - ``'dense'``  — single-device XLA attention (reference behavior).
     - ``'ring'``   — context-parallel ring attention; requires ``seq_axis``
       (a mesh axis the sequence is sharded over) and must be called under
-      ``shard_map``.
+      ``shard_map``; ``seq_layout`` picks the chunk assignment
+      ('zigzag' = load-balanced halves, must match the caller's slicing).
     - ``'flash'``  — Pallas TPU flash-attention kernel (falls back to dense
       off-TPU).
     """
@@ -72,6 +74,7 @@ def causal_attention(
         return ring_causal_attention(
             q, k, v, axis_name=seq_axis, dropout_rate=dropout_rate,
             dropout_rng=dropout_rng, deterministic=deterministic,
+            layout=seq_layout,
         )
     if impl == "flash":
         from .flash_attention import flash_causal_attention

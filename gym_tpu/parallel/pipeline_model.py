@@ -22,6 +22,7 @@ double-counting of replicated compute.
 
 from __future__ import annotations
 
+from collections.abc import Mapping
 from typing import Any, Optional, Tuple
 
 import flax.linen as nn
@@ -189,13 +190,14 @@ class PipelinedGPTLossModel:
                 if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
             outer, stages = cast(outer), cast(stages)
 
-        pos0 = 0
+        pos_vec = None
         if cfg.seq_axis is not None:
-            # context parallelism: this device owns one contiguous token
-            # chunk — the shared cp slicing contract
+            # context parallelism: this device slices its own token chunk
+            # (contiguous or zig-zag halves) — the shared cp slicing
+            # contract
             from ..models.nanogpt import slice_seq_chunk
-            idx, targets, pos0 = slice_seq_chunk(idx, targets,
-                                                 cfg.seq_axis, axis=2)
+            idx, targets, pos_vec = slice_seq_chunk(
+                idx, targets, cfg.seq_axis, axis=2, layout=cfg.seq_layout)
             t = idx.shape[2]
 
         sid = lax.axis_index(PIPE_AXIS)
@@ -205,7 +207,8 @@ class PipelinedGPTLossModel:
 
         wte = outer["wte"]["embedding"]
         wpe = outer["wpe"]["embedding"]
-        x = wte[idx] + wpe[pos0 + jnp.arange(t)][None, None]  # [M, B, T, C]
+        pos = jnp.arange(t) if pos_vec is None else pos_vec
+        x = wte[idx] + wpe[pos][None, None]            # [M, B, T, C]
         if drop:
             # embedding dropout (GPT.__call__ applies nn.Dropout after
             # wte+wpe): one mask over all M microbatches — each gets
@@ -315,18 +318,21 @@ def _apply_ln_f(x, ln_params, cfg: GPTConfig):
 def _map_pipe_subtrees(tree, is_target, fn):
     """Recursive structural walk applying ``fn`` to every subtree for
     which ``is_target`` is true — reaches param-mirroring copies inside
-    strategy state (optax NamedTuples, DiLoCo's master, module lists)."""
-    if isinstance(tree, dict):
-        if is_target(tree):
-            return fn(tree)
-        return {k: _map_pipe_subtrees(v, is_target, fn)
-                for k, v in tree.items()}
-    if isinstance(tree, (list, tuple)):
-        mapped = [_map_pipe_subtrees(v, is_target, fn) for v in tree]
-        if hasattr(tree, "_fields"):           # NamedTuple (optax states)
-            return type(tree)(*mapped)
-        return type(tree)(mapped)
-    return tree
+    strategy state (optax NamedTuples, DiLoCo's master, module lists).
+
+    Routed through ``jax.tree_util`` one-level flattening (ADVICE r4) so
+    ANY registered pytree container — dict/list/tuple/NamedTuple, but also
+    flax FrozenDict or a strategy's custom dataclass node — is recursed
+    into and rebuilt, rather than silently passing a stage-stacked subtree
+    through to a checkpoint that claims the canonical layout."""
+    if isinstance(tree, Mapping) and is_target(tree):
+        return fn(tree)
+    if jax.tree_util.all_leaves([tree]):
+        return tree
+    kids, treedef = jax.tree_util.tree_flatten(
+        tree, is_leaf=lambda t: t is not tree)
+    return jax.tree_util.tree_unflatten(
+        treedef, [_map_pipe_subtrees(k, is_target, fn) for k in kids])
 
 
 def _is_pipeline_layout(d) -> bool:

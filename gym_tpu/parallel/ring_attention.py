@@ -8,9 +8,15 @@ K/V chunks rotate around the ring via ``lax.ppermute`` while a
 flash-attention-style online softmax accumulates the output
 (Liu et al., Ring Attention with Blockwise Transformers, arXiv:2310.01889).
 
-Causality makes half the ring steps no-ops for a given pair; those blocks are
-masked (static control flow — XLA-friendly) rather than skipped. Peak memory
-per device is O(T/c · T/c) for one logits block instead of O(T²).
+Causality makes half the ring steps no-ops for a given pair under the
+naive contiguous chunk assignment; those blocks are masked (static control
+flow — XLA-friendly) rather than skipped. The **zig-zag layout** (default
+through the GPT integration, VERDICT r4 #5) reclaims that dead compute:
+device ``i`` holds half-chunks ``i`` and ``2n−1−i`` of the sequence, so
+every ring step computes exactly two always-live half blocks — the causal
+work is load-balanced across the ring and the per-step kernel cost halves.
+Peak memory per device is O(T/c · T/c) for one logits block instead of
+O(T²).
 
 Usable standalone under ``shard_map`` or through the
 ``gym_tpu.ops.attention.causal_attention`` dispatcher (GPT models pick it up
@@ -64,6 +70,150 @@ def _kernel_blocks_ok(q: jnp.ndarray) -> bool:
     tl, d = q.shape[-2], q.shape[-1]
     return ((fused_attention.INTERPRET or _on_tpu())
             and tl % 128 == 0 and tl <= 1024 and d <= 256)
+
+
+def _lse_merge(o1, lse1, o2, lse2):
+    """Log-sum-exp-space merge of two normalized attention blocks.
+    ``o``: [B,H,T,D] f32; ``lse``: [B,H,T,1] f32. A block gated to
+    ``lse = -1e30`` contributes weight exp(-1e30 − lse_new) = 0."""
+    lse = jnp.logaddexp(lse1, lse2)
+    return o1 * jnp.exp(lse1 - lse) + o2 * jnp.exp(lse2 - lse), lse
+
+
+def _ring_kernel_blocks_zigzag(q, k, v, axis_name: str) -> jnp.ndarray:
+    """Zig-zag ring schedule with Pallas-fused half blocks.
+
+    Local layout (``models.nanogpt.slice_seq_chunk(layout='zigzag')``):
+    rows ``[:h]`` are global half-chunk ``my`` ("lo"), rows ``[h:]`` are
+    half-chunk ``2n−1−my`` ("hi"), ``h = Tl/2``. Whole [2h] K/V chunks
+    rotate exactly like the contiguous schedule (same comm volume); per
+    ring step the causal structure admits exactly TWO live [h×h] full
+    blocks on every device:
+
+    - ``A`` — ``q_hi × k_loᵢₙ``: incoming lo chunk ``s ≤ n−1 < 2n−1−my``
+      is always in q_hi's past;
+    - ``B`` — ``s < my``: ``q_lo × k_loᵢₙ`` (chunk ``s`` before ``my``),
+      else ``q_hi × k_hiᵢₙ`` (chunk ``2n−1−s`` before ``2n−1−my``).
+
+    ``B``'s operands are picked with ``jnp.where`` on the traced ``src``
+    (uniform shapes — SPMD lockstep safe) and its merge destination (lo or
+    hi accumulator) is selected by gating the other side's merge weight to
+    ``-1e30``. Step 0 is static: lo×lo causal, hi×lo full, hi×hi causal.
+    Per-step cost: 2 [h×h] blocks vs the contiguous schedule's one
+    [2h×2h] (= 4 [h×h]) block — the measured ~2× step-time reclaim.
+    Differentiable end-to-end (fused kernels expose lse cotangents)."""
+    from ..ops.fused_attention import fused_block_attention
+
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    h = q.shape[-2] // 2
+    q_lo, q_hi = q[..., :h, :], q[..., h:, :]
+
+    o_lo, lse_lo = fused_block_attention(q_lo, k[..., :h, :],
+                                         v[..., :h, :], True)
+    o_a, lse_a = fused_block_attention(q_hi, k[..., :h, :],
+                                       v[..., :h, :], False)
+    o_h, lse_h = fused_block_attention(q_hi, k[..., h:, :],
+                                       v[..., h:, :], True)
+    o_lo = o_lo.astype(jnp.float32)
+    o_hi, lse_hi = _lse_merge(o_a.astype(jnp.float32), lse_a,
+                              o_h.astype(jnp.float32), lse_h)
+
+    kc = lax.ppermute(k, axis_name, perm)
+    vc = lax.ppermute(v, axis_name, perm)
+
+    def ring_step(carry, r):
+        o_lo, lse_lo, o_hi, lse_hi, kc, vc = carry
+        src = (my - r) % n
+        k_lo, k_hi = kc[..., :h, :], kc[..., h:, :]
+        v_lo, v_hi = vc[..., :h, :], vc[..., h:, :]
+        o_a, lse_a = fused_block_attention(q_hi, k_lo, v_lo, False)
+        o_hi, lse_hi = _lse_merge(o_hi, lse_hi,
+                                  o_a.astype(jnp.float32), lse_a)
+        cond = src < my
+        q_b = jnp.where(cond, q_lo, q_hi)
+        k_b = jnp.where(cond, k_lo, k_hi)
+        v_b = jnp.where(cond, v_lo, v_hi)
+        o_b, lse_b = fused_block_attention(q_b, k_b, v_b, False)
+        o_b = o_b.astype(jnp.float32)
+        o_lo, lse_lo = _lse_merge(o_lo, lse_lo, o_b,
+                                  jnp.where(cond, lse_b, -1e30))
+        o_hi, lse_hi = _lse_merge(o_hi, lse_hi, o_b,
+                                  jnp.where(cond, -1e30, lse_b))
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (o_lo, lse_lo, o_hi, lse_hi, kc, vc), None
+
+    (o_lo, _, o_hi, _, _, _), _ = lax.scan(
+        ring_step, (o_lo, lse_lo, o_hi, lse_hi, kc, vc), jnp.arange(1, n))
+    return jnp.concatenate([o_lo, o_hi], axis=-2).astype(q.dtype)
+
+
+def _ring_dense_zigzag(q, k, v, axis_name: str, dropout_rate: float,
+                       dropout_rng) -> jnp.ndarray:
+    """Zig-zag schedule on dense XLA half blocks (CPU tests / non-eligible
+    chunk sizes / attention dropout). Same block structure as
+    ``_ring_kernel_blocks_zigzag`` with (m, l) online-softmax accumulators;
+    a gated block contributes via ``m = -1e30`` ⇒ weight 0. Dropout draws
+    one fold per (ring step, block) — statistically equivalent to, but not
+    bitwise the same as, the contiguous schedule's draws."""
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    h = q.shape[-2] // 2
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    q_lo, q_hi = q[..., :h, :], q[..., h:, :]
+    full = jnp.ones((h, h), bool)
+    causal = jnp.tril(full)
+    drop_active = dropout_rate > 0.0 and dropout_rng is not None
+
+    def rng_for(r, blk):
+        return (jax.random.fold_in(dropout_rng, r * 3 + blk)
+                if drop_active else None)
+
+    def merge(acc, o2, m2, l2):
+        o1, m1, l1 = acc
+        m = jnp.maximum(m1, m2)
+        a, b = jnp.exp(m1 - m), jnp.exp(m2 - m)
+        return o1 * a + o2 * b, m, l1 * a + l2 * b
+
+    rate = dropout_rate if drop_active else 0.0
+    acc_lo = _block_attend(q_lo, k[..., :h, :], v[..., :h, :], causal,
+                           scale, rate, rng_for(0, 0))
+    acc_hi = _block_attend(q_hi, k[..., :h, :], v[..., :h, :], full,
+                           scale, rate, rng_for(0, 1))
+    acc_hi = merge(acc_hi, *_block_attend(q_hi, k[..., h:, :],
+                                          v[..., h:, :], causal, scale,
+                                          rate, rng_for(0, 2)))
+
+    kc = lax.ppermute(k, axis_name, perm)
+    vc = lax.ppermute(v, axis_name, perm)
+
+    def ring_step(carry, r):
+        acc_lo, acc_hi, kc, vc = carry
+        src = (my - r) % n
+        k_lo, k_hi = kc[..., :h, :], kc[..., h:, :]
+        v_lo, v_hi = vc[..., :h, :], vc[..., h:, :]
+        acc_hi2 = merge(acc_hi, *_block_attend(q_hi, k_lo, v_lo, full,
+                                               scale, rate, rng_for(r, 0)))
+        cond = src < my
+        q_b = jnp.where(cond, q_lo, q_hi)
+        k_b = jnp.where(cond, k_lo, k_hi)
+        v_b = jnp.where(cond, v_lo, v_hi)
+        o_b, m_b, l_b = _block_attend(q_b, k_b, v_b, full, scale, rate,
+                                      rng_for(r, 1))
+        acc_lo2 = merge(acc_lo, o_b, jnp.where(cond, m_b, -1e30), l_b)
+        acc_hi2 = merge(acc_hi2, o_b, jnp.where(cond, -1e30, m_b), l_b)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (acc_lo2, acc_hi2, kc, vc), None
+
+    ((o_lo, _, l_lo), (o_hi, _, l_hi), _, _), _ = lax.scan(
+        ring_step, (acc_lo, acc_hi, kc, vc), jnp.arange(1, n))
+    out = jnp.concatenate([o_lo / jnp.maximum(l_lo, 1e-30),
+                           o_hi / jnp.maximum(l_hi, 1e-30)], axis=-2)
+    return out.astype(q.dtype)
 
 
 def _ring_kernel_blocks(q, k, v, axis_name: str) -> jnp.ndarray:
@@ -121,18 +271,27 @@ def ring_causal_attention(
     dropout_rate: float = 0.0,
     dropout_rng: Optional[jax.Array] = None,
     deterministic: bool = True,
+    layout: str = "contiguous",
 ) -> jnp.ndarray:
     """Causal attention with the sequence sharded over ``axis_name``.
 
-    Device ``i`` owns global positions ``[i·Tl, (i+1)·Tl)``. K/V rotate
-    around the ring; an online softmax merges each incoming block, so the
-    result is bitwise-equivalent math to dense causal attention over the
-    full sequence (up to fp reassociation).
+    ``layout='contiguous'``: device ``i`` owns global positions
+    ``[i·Tl, (i+1)·Tl)``. ``layout='zigzag'``: device ``i`` owns global
+    half-chunks ``i`` and ``2n−1−i`` (rows ``[:Tl/2]`` / ``[Tl/2:]``) —
+    the load-balanced assignment that halves per-step compute; the CALLER
+    must slice q/k/v in that layout
+    (``models.nanogpt.slice_seq_chunk(layout='zigzag')``). Either way K/V
+    rotate around the ring and an online softmax merges each incoming
+    block, so the result is the same math as dense causal attention over
+    the full sequence (up to fp reassociation), rows ordered in the local
+    layout.
 
     Dispatch: a 1-wide ring is local causal attention and routes through
     the flash dispatcher (so cp=1 long context rides the tiled kernel);
-    wider rings use Pallas-fused blocks when the chunk is kernel-eligible
-    (``_kernel_blocks_ok``), else the dense XLA block path below.
+    wider rings use Pallas-fused blocks when the (half-)chunk is
+    kernel-eligible, else dense XLA blocks. An odd ``Tl`` cannot split
+    into zig-zag halves and falls back to the contiguous schedule — the
+    slicing side makes the same static decision.
     """
     n = lax.axis_size(axis_name)
     drop = dropout_rate > 0.0 and not deterministic
@@ -141,6 +300,12 @@ def ring_causal_attention(
         return flash_causal_attention(
             q, k, v, dropout_rate=dropout_rate, dropout_rng=dropout_rng,
             deterministic=deterministic)
+    if layout == "zigzag" and q.shape[-2] % 2 == 0:
+        if not drop and _kernel_blocks_ok(q[..., : q.shape[-2] // 2, :]):
+            return _ring_kernel_blocks_zigzag(q, k, v, axis_name)
+        return _ring_dense_zigzag(q, k, v, axis_name,
+                                  dropout_rate if drop else 0.0,
+                                  dropout_rng if drop else None)
     if not drop and _kernel_blocks_ok(q):
         return _ring_kernel_blocks(q, k, v, axis_name)
     my = lax.axis_index(axis_name)

@@ -539,3 +539,34 @@ def test_fit_pp2_cp2_matches_unsharded():
         a = [l for _, l in r0.history[key]]
         b = [l for _, l in r.history[key]]
         np.testing.assert_allclose(b, a, rtol=2e-4, atol=1e-5)
+
+
+def test_map_pipe_subtrees_reaches_custom_pytree_containers():
+    """ADVICE r4: a pipeline-layout subtree hiding inside a registered
+    custom pytree container (flax FrozenDict, struct dataclass) must be
+    rewritten, not silently passed through to a 'canonical' checkpoint."""
+    import flax.struct
+    from flax.core import FrozenDict
+
+    from gym_tpu.parallel.pipeline_model import (_is_pipeline_layout,
+                                                 _map_pipe_subtrees)
+
+    @flax.struct.dataclass
+    class Box:
+        inner: dict
+
+    layout = {"outer": {"a": jnp.zeros(2)}, "stages": {"b": jnp.zeros(3)}}
+    tree = {
+        "plain": dict(layout),
+        "frozen": FrozenDict({"inner": dict(layout)}),
+        "boxed": Box(inner=dict(layout)),
+        "leaf": jnp.ones(2),
+    }
+    hits = []
+    out = _map_pipe_subtrees(tree, _is_pipeline_layout,
+                             lambda s: hits.append(s) or "CONVERTED")
+    assert out["plain"] == "CONVERTED"
+    assert out["frozen"]["inner"] == "CONVERTED"
+    assert out["boxed"].inner == "CONVERTED"
+    assert len(hits) == 3
+    np.testing.assert_array_equal(out["leaf"], tree["leaf"])

@@ -207,3 +207,115 @@ def test_ring_kernel_blocks_match_dense(devices8, n):
     for a, b in zip(g_ring, g_dense):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=2e-5, rtol=1e-4)
+
+
+def _zigzag_perm(t, n):
+    """Global row order that makes contiguous per-device shards hold the
+    zig-zag layout: device i gets half-chunks i and 2n-1-i."""
+    h = t // (2 * n)
+    idx = []
+    for i in range(n):
+        idx.extend(range(i * h, (i + 1) * h))
+        idx.extend(range((2 * n - 1 - i) * h, (2 * n - i) * h))
+    return np.array(idx)
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_ring_zigzag_matches_dense(devices8, n):
+    """Zig-zag schedule ≡ dense causal attention (rows permuted into the
+    zig-zag device layout and back)."""
+    rng = np.random.default_rng(5)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((2, 3, 64, 8)), jnp.float32)
+        for _ in range(3)
+    )
+    perm = _zigzag_perm(64, n)
+    mesh = Mesh(np.array(devices8[:n]), ("seq",))
+    spec = P(None, None, "seq", None)
+
+    def f(q, k, v):
+        return ring_causal_attention(q, k, v, axis_name="seq",
+                                     layout="zigzag")
+
+    with jax.default_matmul_precision("highest"):
+        out = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec
+        ))(q[..., perm, :], k[..., perm, :], v[..., perm, :])
+        ref = dense_causal_attention(q, k, v)
+    inv = np.argsort(perm)
+    np.testing.assert_allclose(np.asarray(out)[..., inv, :],
+                               np.asarray(ref), atol=2e-6, rtol=1e-5)
+
+
+def test_ring_zigzag_dropout_finite(devices8):
+    """The dense-zigzag dropout path: finite, differs from deterministic,
+    keeps denominator normalization."""
+    rng = np.random.default_rng(6)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((1, 2, 32, 8)), jnp.float32)
+        for _ in range(3)
+    )
+    perm = _zigzag_perm(32, 4)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+    spec = P(None, None, "seq", None)
+
+    def f(det):
+        def g(q, k, v):
+            return ring_causal_attention(
+                q, k, v, axis_name="seq", layout="zigzag",
+                dropout_rate=0.5, dropout_rng=jax.random.PRNGKey(0),
+                deterministic=det)
+        return jax.jit(jax.shard_map(
+            g, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec
+        ))(q[..., perm, :], k[..., perm, :], v[..., perm, :])
+
+    out, det = f(False), f(True)
+    assert np.all(np.isfinite(np.asarray(out)))
+    assert not np.allclose(np.asarray(out), np.asarray(det))
+    assert np.abs(np.asarray(out)).max() < np.abs(np.asarray(v)).max() * 4
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_ring_zigzag_kernel_blocks_match_dense(devices8, n):
+    """Pallas-fused zig-zag blocks: same values AND gradients as dense
+    causal attention (lse cotangents flow through the gated merges)."""
+    from gym_tpu.ops import fused_attention
+
+    rng = np.random.default_rng(7)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((1, 2, 1024, 16)), jnp.float32)
+        for _ in range(3)
+    )
+    perm = _zigzag_perm(1024, n)
+    inv = np.argsort(perm)
+    fused_attention.INTERPRET = True
+    try:
+        mesh = Mesh(np.array(devices8[:n]), ("seq",))
+        spec = P(None, None, "seq", None)
+
+        def loss_ring(q, k, v):
+            def f(q, k, v):
+                return ring_causal_attention(q, k, v, axis_name="seq",
+                                             layout="zigzag")
+            out = jax.shard_map(f, mesh=mesh, in_specs=(spec,) * 3,
+                                out_specs=spec, check_vma=False)(
+                q[..., perm, :], k[..., perm, :], v[..., perm, :])
+            out = out[..., inv, :]
+            return (out.astype(jnp.float32) ** 2).mean(), out
+
+        def loss_dense(q, k, v):
+            out = dense_causal_attention(q, k, v)
+            return (out.astype(jnp.float32) ** 2).mean(), out
+
+        with jax.default_matmul_precision("highest"):
+            (_, out), g_ring = jax.value_and_grad(
+                loss_ring, argnums=(0, 1, 2), has_aux=True)(q, k, v)
+            (_, ref), g_dense = jax.value_and_grad(
+                loss_dense, argnums=(0, 1, 2), has_aux=True)(q, k, v)
+    finally:
+        fused_attention.INTERPRET = False
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
+    for a, b in zip(g_ring, g_dense):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5, rtol=1e-4)
