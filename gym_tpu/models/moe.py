@@ -43,47 +43,51 @@ def _init_normal(std: float):
     return nn.initializers.normal(stddev=std)
 
 
-def _is_batched(x) -> bool:
-    """True when the MoE layer is being traced under ``vmap`` — used to
-    steer the dispatch away from ``lax.ragged_dot``, whose batched form the
-    TPU backend rejects ('number of batch dimensions should be 0') and
-    whose CPU batching rule is partial. Public-API detection only
-    (VERDICT r3 #8 — no ``jax._src`` imports). Two signals (both needed):
+def _grouped_dot(x, w, sorted_e, chunk_rows: int):
+    """``ops.grouped_matmul.grouped_dot`` in row blocks of ``chunk_rows``
+    (VERDICT r4 #7: the single whole-array grouped matmul exceeds
+    Mosaic's VMEM stack at GPT-base batch 16 — S·K = 32768 rows — while
+    batch 12 fit; chunking bounds the kernel's working set regardless of
+    batch).
 
-    - the runtime's ``'vnode'`` virtual-node axis is live, queried via
-      ``lax.axis_size`` (raises NameError when unbound) — catches the
-      simulator's vmap even from inside ``lax.scan`` bodies, where values
-      are plain jaxpr tracers, not BatchTracers;
-    - the value's tracer class — catches direct user vmaps. The class is
-      discovered by a one-time ``eval_shape(vmap(probe))`` feature test
-      (ADVICE r4: matching the private class NAME as a string would break
-      silently on a JAX-internal rename), so whatever class vmap actually
-      uses on this JAX version is what we match; ``eval_shape`` keeps the
-      probe abstract — no backend/device is ever touched.
+    ``sorted_e`` (the per-row expert id, ascending) is the single source
+    of the grouping — every (sub)call histograms its own group sizes from
+    it, so no redundant precomputed sizes can silently disagree. A
+    contiguous slice of expert-sorted rows is itself expert-sorted, so
+    each block is a valid grouped matmul (groups split across a boundary
+    just contribute to both blocks). Padding rows carry expert id E−1 —
+    the maximum — keeping the sorted invariant; their outputs are sliced
+    off. The primitive's flattening batch rule (not ``custom_vmap``,
+    which breaks under ``vmap(grad(...))`` — see ops/grouped_matmul.py)
+    makes every path here vmap- AND grad-safe, so vnode-folded node
+    programs keep ragged-class throughput instead of falling back to the
+    E/topk×-FLOPs dense dispatch."""
+    from ..ops.grouped_matmul import grouped_dot
 
-    The Trainer additionally pins ``moe_impl`` from the mesh shape at
-    ``fit()`` time (``trainer.py``), so trainer runs never reach this
-    probe; it serves standalone layer use (unit tests, user vmaps)."""
-    from ..parallel.axis import VNODE_AXIS
-    try:
-        jax.lax.axis_size(VNODE_AXIS)
-        return True
-    except NameError:
-        pass
-    return isinstance(x, _batch_tracer_cls())
+    n = x.shape[0]
+    n_experts = w.shape[0]
 
+    def sizes(e):
+        return jnp.sum(e[:, None] == jnp.arange(n_experts)[None, :],
+                       axis=0, dtype=jnp.int32)
 
-_BATCH_TRACER_CLS: Optional[type] = None
+    if chunk_rows <= 0 or n <= chunk_rows:
+        return grouped_dot(x, w, sizes(sorted_e))
+    pad = (-n) % chunk_rows
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        sorted_e = jnp.concatenate(
+            [sorted_e, jnp.full((pad,), n_experts - 1, sorted_e.dtype)])
+    n_chunks = (n + pad) // chunk_rows
+    xc = x.reshape(n_chunks, chunk_rows, x.shape[-1])
+    ec = sorted_e.reshape(n_chunks, chunk_rows)
 
+    def one(args):
+        x_c, e_c = args
+        return grouped_dot(x_c, w, sizes(e_c))
 
-def _batch_tracer_cls() -> type:
-    global _BATCH_TRACER_CLS
-    if _BATCH_TRACER_CLS is None:
-        seen = []
-        jax.eval_shape(jax.vmap(lambda v: seen.append(type(v)) or v),
-                       jax.ShapeDtypeStruct((1, 1), jnp.float32))
-        _BATCH_TRACER_CLS = seen[0]
-    return _BATCH_TRACER_CLS
+    h = jax.lax.map(one, (xc, ec))
+    return h.reshape(-1, w.shape[-1])[:n]
 
 
 def _constrain(x, spec):
@@ -129,10 +133,17 @@ class MoEMLP(nn.Module):
     #       FLOPs, but vmap-safe and static-shaped everywhere.
     #   'auto' — einsum under EP (expert_axis set: the standard GShard
     #       capacity semantics, an explicit *config* choice, not topology);
-    #       otherwise ragged on physical-node programs and dense under the
-    #       vmapped vnode axis — both drop-free and the same objective, so
-    #       how K simulated nodes fold onto devices cannot change the loss.
+    #       otherwise ragged everywhere (since r5 the grouped matmul is a
+    #       first-class primitive whose flattening batching rule makes it
+    #       vmap+grad-safe — ops/grouped_matmul.py — so vnode-folded
+    #       programs keep the ragged path too; the objective is identical
+    #       however K simulated nodes fold onto devices). 'dense' remains
+    #       as the explicit vmap-safe reference implementation.
     moe_impl: str = "auto"
+    # Row-block size for the chunked grouped matmul (VERDICT r4 #7): caps
+    # the ragged_dot working set so GPT-base batch 16 (S·K = 32768 rows)
+    # stays under Mosaic's VMEM stack limit. <= 0 disables chunking.
+    chunk_rows: int = 16384
 
     @nn.compact
     def __call__(self, x, train: bool) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -145,12 +156,7 @@ class MoEMLP(nn.Module):
 
         impl = self.moe_impl
         if impl == "auto":
-            if self.expert_axis:
-                impl = "einsum"
-            elif _is_batched(x):
-                impl = "dense"
-            else:
-                impl = "ragged"
+            impl = "einsum" if self.expert_axis else "ragged"
         assert impl in ("einsum", "ragged", "dense"), impl
         assert not (impl == "ragged" and self.expert_axis), (
             "ragged MoE dispatch cannot shard experts (use moe_impl='einsum' "
@@ -180,9 +186,11 @@ class MoEMLP(nn.Module):
                 return self._ragged(xf, gates, logits, w_fc, b_fc, w_pr,
                                     b_pr, (B, T, C), train)
             except NotImplementedError:
-                # lax.ragged_dot has no general batching rule: under a
-                # vmapped node program (virtual nodes, K > devices) fall
-                # back to the dense all-experts dispatch — same objective
+                # safety net only: the grouped-matmul primitive carries
+                # its own batching rule, so vmapped programs normally stay
+                # on the ragged path; an exotic transform that still
+                # refuses to lower falls back to the dense same-objective
+                # dispatch
                 impl = "dense"
         if impl == "dense":
             return self._dense(xf, gates, logits, w_fc, b_fc, w_pr, b_pr,
@@ -286,13 +294,12 @@ class MoEMLP(nn.Module):
         order = jnp.argsort(flat_e)            # stable: ties keep token order
         tok = order // K                       # source token per sorted row
         xs = jnp.take(xf, tok, axis=0)                             # [S·K, C]
-        group_sizes = jnp.bincount(flat_e, length=E).astype(jnp.int32)
         sorted_e = jnp.take(flat_e, order)
-        h = jax.lax.ragged_dot(xs, w_fc.astype(dtype), group_sizes)
+        h = _grouped_dot(xs, w_fc.astype(dtype), sorted_e, self.chunk_rows)
         if b_fc is not None:
             h = h + jnp.take(b_fc.astype(dtype), sorted_e, axis=0)
         h = nn.gelu(h)
-        ye = jax.lax.ragged_dot(h, w_pr.astype(dtype), group_sizes)
+        ye = _grouped_dot(h, w_pr.astype(dtype), sorted_e, self.chunk_rows)
         if b_pr is not None:
             ye = ye + jnp.take(b_pr.astype(dtype), sorted_e, axis=0)
         gate_rows = jnp.take(topg.reshape(-1), order).astype(dtype)

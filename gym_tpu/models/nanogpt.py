@@ -70,6 +70,7 @@ class GPTConfig:
     moe_z_weight: float = 1e-3
     expert_axis: Optional[str] = None
     moe_impl: str = "auto"  # 'ragged'|'einsum'|'dense'|'auto' (models/moe.py)
+    moe_chunk_rows: int = 16384  # grouped-matmul row blocking (models/moe.py)
     # Chunked cross-entropy: compute the lm_head matmul + CE over row
     # chunks of `loss_chunk` tokens under `jax.checkpoint`, so the full
     # [B·T, vocab] f32 logits tensor is never materialized (at GPT-2 base
@@ -268,7 +269,8 @@ class MoEBlock(nn.Module):
             topk=cfg.expert_topk, capacity_factor=cfg.capacity_factor,
             dropout=cfg.dropout, bias=cfg.bias,
             aux_weight=cfg.moe_aux_weight, z_weight=cfg.moe_z_weight,
-            expert_axis=cfg.expert_axis, moe_impl=cfg.moe_impl, name="moe",
+            expert_axis=cfg.expert_axis, moe_impl=cfg.moe_impl,
+            chunk_rows=cfg.moe_chunk_rows, name="moe",
         )(nn.LayerNorm(epsilon=1e-5, use_bias=cfg.bias, name="ln_2")(x), train)
         return x + y, aux
 

@@ -6,6 +6,7 @@ remaining N−k — including the data-iterator position and per-node RNG, so
 the resumed run sees the exact same batch sequence.
 """
 
+import pytest
 import shutil
 
 import jax
@@ -32,6 +33,7 @@ def _fit(ds, max_steps, tmp, interval, strategy=None, run_name="ckpt_test",
     )
 
 
+@pytest.mark.slow
 def test_resume_matches_straight_run(tmp_path):
     ds = blobs(256, seed=5)
     straight_dir = str(tmp_path / "straight")
@@ -66,6 +68,7 @@ def test_keep_latest_pruning(tmp_path):
     shutil.rmtree(str(tmp_path), ignore_errors=True)
 
 
+@pytest.mark.slow
 def test_resume_matches_straight_run_demo(tmp_path):
     """Same oracle with DeMo: its strategy state is the pooled chunk-layout
     momentum dict ('{a}x{b}' → [G, a, b]), a different pytree shape than
@@ -94,6 +97,7 @@ def test_resume_matches_straight_run_demo(tmp_path):
     shutil.rmtree(str(tmp_path), ignore_errors=True)
 
 
+@pytest.mark.slow
 def test_resume_matches_straight_run_pipeline(tmp_path):
     """Checkpoint/resume under pipeline parallelism: the pp TrainState
     (stage-sharded {'outer','stages'} params + mirrored strategy state)
@@ -131,6 +135,7 @@ def test_resume_matches_straight_run_pipeline(tmp_path):
     shutil.rmtree(str(tmp_path), ignore_errors=True)
 
 
+@pytest.mark.slow
 def test_cross_topology_restore_pp2_tp2_to_pp1(tmp_path):
     """Cross-topology restore (VERDICT r3 #6): checkpoints are written in
     the CANONICAL plain-GPT layout, so a run saved under fit(pp=2, tp=2)

@@ -7,6 +7,7 @@ forward at every position (teacher forcing), and greedy sampling must
 match the parity ``generate``.
 """
 
+import pytest
 import dataclasses
 
 import jax
@@ -49,6 +50,7 @@ def test_cached_decode_logits_match_full_forward():
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_moe_decode_composes():
     """KV-cache decode over an MoE GPT: prefill logits equal the full
     forward, and generate_fast runs end-to-end (the MoE layer is
@@ -81,6 +83,7 @@ def test_moe_decode_composes():
     assert out.min() >= 0 and out.max() < cfg.vocab_size
 
 
+@pytest.mark.slow
 def test_generate_fast_matches_generate_greedy():
     cfg, model, params, idx = _setup()
     # top_k=1 → both samplers are argmax decoders; trajectories must agree

@@ -309,6 +309,7 @@ def test_demo_segmented_pipeline_is_exact(n_nodes):
         s_seg, s_one)
 
 
+@pytest.mark.slow
 def test_demo_bf16_delta_trains():
     """delta_dtype=bf16 halves the residual-state memory (the knob that
     fits 8-node GPT-2-base DeMo on one chip). The encode still runs in

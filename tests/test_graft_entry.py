@@ -111,6 +111,7 @@ def test_dryrun_multichip_survives_hanging_site_hook(tmp_path):
     assert "[dryrun] shape 1" in proc.stdout
 
 
+@pytest.mark.slow
 def test_hanging_poison_actually_hangs(tmp_path):
     """Sanity: the poison sitecustomize really does block jax.devices().
 

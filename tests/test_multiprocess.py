@@ -161,6 +161,7 @@ def _reference_fit_histories(tmp: str):
     )
 
 
+@pytest.mark.slow
 def test_two_process_trainer_fit_matches_single_process(tmp_path):
     """VERDICT r3 #1: ``Trainer.fit`` ITSELF runs in a multi-process
     world — both processes call fit() unmodified and must reproduce the
@@ -215,6 +216,7 @@ def test_two_process_trainer_fit_matches_single_process(tmp_path):
     mgr.close()
 
 
+@pytest.mark.slow
 def test_two_process_world_matches_single_process():
     port = _free_port()
     env = dict(os.environ)

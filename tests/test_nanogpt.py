@@ -93,6 +93,7 @@ def test_num_params_and_crop_and_decay_mask():
     assert mask["h_0"]["attn"]["c_attn"]["bias"] is False
 
 
+@pytest.mark.slow
 def test_generate():
     cfg = tiny_cfg(dropout=0.0)
     model = GPT(cfg)
@@ -104,6 +105,7 @@ def test_generate():
     assert np.all((out >= 0) & (out < 66))
 
 
+@pytest.mark.slow
 def test_gpt_trains_on_mesh():
     """16-node FedAvg on a char-level GPT (BASELINE config #4 shape, tiny)."""
     from gym_tpu import Trainer
@@ -158,6 +160,7 @@ def test_lazy_owt_chunks(tmp_path):
     np.testing.assert_array_equal(x[0][1:], y[0][:-1])
 
 
+@pytest.mark.slow
 def test_build_dataset_small_cache_roundtrip(tmp_path):
     d1, v1 = build_dataset_small("shakespeare", 32, 0.0, 0.01,
                                  data_root=str(tmp_path))
@@ -168,6 +171,7 @@ def test_build_dataset_small_cache_roundtrip(tmp_path):
     assert d1.max() < 66
 
 
+@pytest.mark.slow
 def test_get_dataset_selector(tmp_path):
     ds, vocab = get_dataset("shakespeare", 16, 0.0, 0.01,
                             data_root=str(tmp_path))

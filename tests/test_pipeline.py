@@ -5,6 +5,7 @@ sequentially on one device — forward AND backward (the backward pipeline
 comes from autodiff of scan+ppermute, so gradient equality is the real
 test of the schedule)."""
 
+import pytest
 import functools
 
 import jax
@@ -100,6 +101,7 @@ def test_pipeline_grads_match_sequential():
                                atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_pipeline_gpt_trunk_matches_plain_forward():
     """Compose with the real model: the GPT block trunk (h_0..h_{L-1})
     executed as a 2-stage pipeline must reproduce the plain forward's
@@ -204,6 +206,7 @@ def _pp_fit(pp, num_nodes=2, n_layer=4, max_steps=6, dataset=None,
     )
 
 
+@pytest.mark.slow
 def test_fit_pp2_matches_pp1():
     """VERDICT r2 weak #5 resolution: the FULL GPT (embeddings, 4-layer
     trunk in 2 stages, ln_f + tied head) trained through fit(pp=2) must
@@ -220,6 +223,7 @@ def test_fit_pp2_matches_pp1():
         np.testing.assert_allclose(b, a, rtol=2e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_fit_pp2_params_match_pp1_one_sgd_step():
     """Tight parameter parity, isolated from Adam's noise amplification
     (its per-element normalization turns schedule-level float
@@ -242,6 +246,7 @@ def test_fit_pp2_params_match_pp1_one_sgd_step():
         r2.params, r1.params)
 
 
+@pytest.mark.slow
 def test_fit_pp2_with_vnode_folding():
     """pp composes with vnode folding: 8 simulated nodes x 2 stages on 8
     devices (4 physical node slots x V=2) — same trajectory as pp=1."""
@@ -256,6 +261,7 @@ def test_fit_pp2_with_vnode_folding():
     np.testing.assert_allclose(b, a, rtol=2e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_fit_pp_trains_on_real_data():
     """Convergence on the real-English docs corpus: 30 steps of 2-node x
     2-stage DiLoCo GPT — loss falls."""
@@ -269,6 +275,7 @@ def test_fit_pp_trains_on_real_data():
     assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
 
 
+@pytest.mark.slow
 def test_fit_pp2_zero_matches_pp1():
     """pp x ZeRO-1 (VERDICT r3 #2): the sharded-optimizer strategy under
     pipeline parallelism — each (node, stage) device ravels its OWN local
@@ -293,6 +300,7 @@ def test_fit_pp2_zero_matches_pp1():
         np.testing.assert_allclose(b, a, rtol=2e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_fit_pp2_clip_matches_pp1():
     """The pp-aware global-norm clip (base._maybe_clip): with max_norm
     low enough to always fire, pp=2 must match pp=1 — a per-device norm
@@ -313,6 +321,7 @@ def test_fit_pp2_clip_matches_pp1():
     np.testing.assert_allclose(b, a, rtol=2e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_fit_pp2_diloco_shard_outer_matches_replicated():
     """pp x DiLoCo(shard_outer=True): the flat sharded outer master under
     pp slices each stage's own view — must equal the replicated-outer run
@@ -335,6 +344,7 @@ def test_fit_pp2_diloco_shard_outer_matches_replicated():
     np.testing.assert_allclose(sh, rep, rtol=2e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_fit_pp2_demo_trains_with_stage_local_state():
     """pp x DeMo: the pooled DCT residuals chunk each stage's own param
     view (chunk boundaries follow the pipeline layout, so the trajectory
@@ -362,6 +372,7 @@ def test_fit_pp2_demo_trains_with_stage_local_state():
     assert varying, "stage residuals identical: pipe state collapsed"
 
 
+@pytest.mark.slow
 def test_fit_pp_multi_step_dispatch_and_autocast():
     """pp composes with the multi-step dispatch (lax.scan of the
     pipelined step) and with bf16 autocast: same trajectory as the
@@ -394,6 +405,7 @@ def test_fit_pp_multi_step_dispatch_and_autocast():
     assert all(np.isfinite(v) for _, v in rb.history["global_loss"])
 
 
+@pytest.mark.slow
 def test_fit_pp_composes_with_partial_participation():
     """Fault simulation (shared-PRNG partial participation on DiLoCo's
     outer round) composes with pipeline parallelism: the alive-mask and
@@ -417,6 +429,7 @@ def test_fit_pp_composes_with_partial_participation():
     assert any(abs(a - b) > 1e-7 for a, b in zip(losses[3:], full[3:]))
 
 
+@pytest.mark.slow
 def test_fit_pp2_dropout_trains():
     """VERDICT r3 #5: fit(pp=K, dropout>0) trains — per-tick dropout rng
     folded per (stage-global layer, microbatch) through the GPipe scan.
@@ -439,6 +452,7 @@ def test_fit_pp2_dropout_trains():
     assert all(np.isfinite(v) for _, v in res.history["global_loss"])
 
 
+@pytest.mark.slow
 def test_fit_pp2_moe_matches_pp1():
     """pp x MoE (VERDICT r3 #2): mixed dense/MoE trunk through GPipe
     stages — dense and MoE layers stacked as separate groups, router aux
@@ -453,6 +467,7 @@ def test_fit_pp2_moe_matches_pp1():
         np.testing.assert_allclose(b, a, rtol=3e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_fit_pp2_ep2_matches_unsharded():
     """pp x ep: a ('node','expert','pipe') mesh — GPipe stages manual
     over 'pipe' while the GSPMD-auto 'expert' axis shards each stage's
@@ -480,6 +495,7 @@ def test_fit_pp_rejects_stage_misaligned_moe():
         _pp_fit(pp=4, moe=True, num_nodes=2)
 
 
+@pytest.mark.slow
 def test_fit_pp2_tp2_matches_unsharded():
     """pp x tp: a ('node','model','pipe') mesh — GPipe stages manual over
     'pipe' while GSPMD Megatron-shards each stage's matmuls over the auto
@@ -496,6 +512,7 @@ def test_fit_pp2_tp2_matches_unsharded():
     np.testing.assert_allclose(b, a, rtol=2e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_fit_pp2_cp2_matches_unsharded():
     """pp x cp: a ('node','seq','pipe') mesh — ring attention over 'seq'
     INSIDE each GPipe stage, token chunks sliced per seq device in

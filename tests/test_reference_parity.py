@@ -143,6 +143,7 @@ def test_demo_dct_basis_parity():
     np.testing.assert_allclose(ours, ref_basis, atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_cnn_loss_parity_with_ported_weights():
     """The head-to-head's identical-init premise (VERDICT r3 #3): the
     torch CNN's state_dict ported through

@@ -122,6 +122,7 @@ def _fit_gpt(cfg, cp, num_nodes=2, steps=6, seed=3):
     return res
 
 
+@pytest.mark.slow
 def test_context_parallel_gpt_matches_dense(devices8):
     """Same seed, same data: cp=2 ring GPT ≡ cp=1 dense GPT."""
     base = dict(block_size=32, vocab_size=17, n_layer=2, n_head=2,
@@ -140,6 +141,7 @@ def test_context_parallel_gpt_matches_dense(devices8):
                                    atol=5e-4, rtol=5e-3)
 
 
+@pytest.mark.slow
 def test_context_parallel_with_diloco(devices8):
     """CP composes with a communication strategy (seq axis orthogonal to the
     node axes): 4 nodes × cp=2 on 8 devices, DiLoCo outer loop fires."""
@@ -161,6 +163,7 @@ def test_context_parallel_with_diloco(devices8):
         assert np.all(np.isfinite(leaf))
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("n", [2, 4])
 def test_ring_kernel_blocks_match_dense(devices8, n):
     """The Pallas-fused block path (diag causal kernel + gated full-block
@@ -275,6 +278,7 @@ def test_ring_zigzag_dropout_finite(devices8):
     assert np.abs(np.asarray(out)).max() < np.abs(np.asarray(v)).max() * 4
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("n", [2, 4])
 def test_ring_zigzag_kernel_blocks_match_dense(devices8, n):
     """Pallas-fused zig-zag blocks: same values AND gradients as dense

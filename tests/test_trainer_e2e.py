@@ -68,6 +68,7 @@ def test_tiny_multinode_loss_decreases():
         assert np.all(np.isfinite(leaf))
 
 
+@pytest.mark.slow
 def test_mnist_cnn_e2e():
     """Reference-parity CNN (example/mnist.py architecture) trains 2-node
     SimpleReduce without NaNs and improves."""
