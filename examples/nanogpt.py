@@ -163,6 +163,10 @@ def main():
                    help="context-parallel devices per node (ring attention)")
     p.add_argument("--attn_impl", default=None,
                    choices=[None, "dense", "flash", "ring"])
+    p.add_argument("--seq_layout", default="zigzag",
+                   choices=["zigzag", "contiguous"],
+                   help="cp chunk assignment (zigzag = load-balanced "
+                        "halves, ~2x ring step; contiguous for A/B)")
     p.add_argument("--autocast", action="store_true",
                    help="bf16 forward pass")
     p.add_argument("--n_experts", type=int, default=0,
@@ -227,6 +231,7 @@ def main():
     cfg.block_size = args.block_size
     cfg.attn_impl = attn
     cfg.seq_axis = "seq" if attn == "ring" else None
+    cfg.seq_layout = args.seq_layout
     cfg.dropout = args.dropout
     if args.n_experts:
         cfg.n_experts = args.n_experts
