@@ -1,0 +1,33 @@
+#!/bin/sh
+# Round-5 chip session: run the pending on-device measurements in priority
+# order the moment the accelerator tunnel is back. Each step appends its
+# log under logs/chip_r5/; a step failing must not block the next.
+# Priorities mirror VERDICT r4 items 2 (headline + GPT-2-base rider),
+# 3 (DeMo 64n vnode-decode payoff), 5 (zig-zag ring step time), and
+# 7 (MoE ragged batch 16).
+set -x
+cd "$(dirname "$0")/.." || exit 1
+mkdir -p logs/chip_r5
+
+# 1. headline + GPT-2-base rider (BENCH_r05 material)
+python bench.py > logs/chip_r5/bench_headline.json 2> logs/chip_r5/bench_headline.err
+
+# 2. DeMo tracked 64-node config (vnode-decode payoff; profile in a 3rd fit)
+python benchmarks/bench_demo_64n.py --steps 12 --profile \
+  > logs/chip_r5/demo64.log 2>&1
+
+# 3. long-context kernel scaling regression on the chip (NB: the zig-zag
+# cp A/B needs >=2 devices; one chip cannot run it — the CPU-mesh A/B in
+# BENCHMARKS.md is the round's layout evidence)
+python benchmarks/bench_long_context.py --mode kernel \
+  > logs/chip_r5/kernel_scaling.log 2>&1
+python benchmarks/bench_long_context.py --mode ring_chip \
+  > logs/chip_r5/ring_chip.log 2>&1
+
+# 4. MoE GPT-2 base batch 16 on the chunked ragged path (r4 ceiling was 12)
+python benchmarks/bench_gpt2_base.py --n-experts 8 --batch 16 \
+  > logs/chip_r5/moe_b16.log 2>&1
+python benchmarks/bench_gpt2_base.py --n-experts 8 --batch 12 \
+  > logs/chip_r5/moe_b12.log 2>&1
+
+echo DONE
