@@ -239,14 +239,13 @@ def _ring_kernel_blocks(q, k, v, axis_name: str) -> jnp.ndarray:
     def ring_step(carry, r):
         o_acc, lse_acc, kc, vc = carry
         src = (my - r) % n
-        # Known cost (ADVICE r3): in SPMD lockstep every device runs the
-        # full kernel every ring step, so the src > my steps — whose merge
-        # weight is zeroed below — are dead compute (~half the kernel
-        # invocations under the contiguous chunk assignment). The standard
-        # fix is the zig-zag/striped chunk assignment (each device holds
-        # chunks i and 2n−1−i, balancing causal work per ring step); kept
-        # as future work — a deliberate simplicity/perf trade recorded
-        # here, not an oversight.
+        # Known cost of THIS (contiguous) schedule: in SPMD lockstep every
+        # device runs the full kernel every ring step, so the src > my
+        # steps — whose merge weight is zeroed below — are dead compute
+        # (~half the invocations). The zig-zag schedules above fix this
+        # (measured 2.0–3.1× at cp=8, BENCHMARKS.md) and are the default
+        # through the GPT integration; this path remains for
+        # layout='contiguous' and odd-chunk fallbacks.
         o_b, lse_b = fused_block_attention(q, kc, vc, False)
         lse_b = jnp.where(src < my, lse_b, -1e30)
         lse_new = jnp.logaddexp(lse_acc, lse_b)
