@@ -28,6 +28,18 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 import numpy as np
 
 
+def shard_map(f, **kw):
+    """Version-portable shard_map (jax >= 0.6 promoted it out of
+    experimental; 0.4.x spells check_vma as check_rep, whose checker
+    also chokes on scan carries — disabled on both)."""
+    import jax
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, **kw)
+    from jax.experimental.shard_map import shard_map as legacy
+    kw.pop("check_vma", None)
+    return legacy(f, check_rep=False, **kw)
+
+
 def bench_kernel(T, impl, B=4, H=8, D=64, inner=10, iters=4):
     """`inner` chained attention calls inside ONE jit so per-dispatch
     transport latency (~100 ms on remote tunnels) amortizes away."""
@@ -91,7 +103,7 @@ def bench_ring(T, cp, B=1, H=4, D=32, iters=5, inner=1, dtype="float32",
 
     # check_vma=False: the kernel-backed block path's pallas out_shapes
     # carry no vma info (same setting as the NodeRuntime programs)
-    sm = jax.shard_map(f, mesh=mesh, in_specs=(spec,) * 3,
+    sm = shard_map(f, mesh=mesh, in_specs=(spec,) * 3,
                        out_specs=spec, check_vma=False)
 
     @jax.jit

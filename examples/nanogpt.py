@@ -190,6 +190,18 @@ def main():
     p.add_argument("--sample", type=int, default=0, metavar="N",
                    help="after training, sample N tokens from the "
                         "node-averaged model (KV-cache decoder)")
+    # host-overlap pipeline knobs (ISSUE 1) — overlap is the default;
+    # the flags select the serial paths for A/Bs and debugging
+    p.add_argument("--no_prefetch", action="store_true",
+                   help="assemble + device_put every batch on the "
+                        "dispatch critical path (overlap off)")
+    p.add_argument("--sync_checkpoint", action="store_true",
+                   help="blocking checkpoint saves instead of the "
+                        "writer-thread overlap")
+    p.add_argument("--compilation_cache_dir", default=None, metavar="DIR",
+                   help="persistent XLA compile cache (repeat runs skip "
+                        "warmup compiles); also honors "
+                        "JAX_COMPILATION_CACHE_DIR")
     args = p.parse_args()
 
     if args.device == "cpu":
@@ -253,6 +265,9 @@ def main():
         pp=args.pp,
         skip_nonfinite=args.skip_nonfinite,
         autocast=args.autocast,
+        prefetch=not args.no_prefetch,
+        async_checkpoint=not args.sync_checkpoint,
+        compilation_cache_dir=args.compilation_cache_dir,
         seed=args.seed,
         val_size=args.val_size,
         val_interval=args.val_interval,

@@ -5,10 +5,12 @@ from .gpt_datasets import (ContiguousGPTTrainDataset,
                            NonContiguousGPTTrainDataset)
 from .offline import (CropAugmentedDataset, build_docs_corpus,
                       load_digits_mnist)
+from .prefetch import HostPrefetcher, dispatch_schedule
 from .sampler import (ArrayDataset, IndexedDataset, NodeBatchIterator,
                       as_dataset, resolve_node_datasets)
 
-__all__ = ["ArrayDataset", "IndexedDataset", "NodeBatchIterator",
+__all__ = ["HostPrefetcher", "dispatch_schedule",
+           "ArrayDataset", "IndexedDataset", "NodeBatchIterator",
            "as_dataset", "resolve_node_datasets", "get_dataset",
            "build_dataset_small", "build_dataset_owt", "generate_char_vocab",
            "char_vocab_size", "ContiguousGPTTrainDataset",

@@ -1,0 +1,237 @@
+"""Host-overlap pipeline: background batch assembly + transfer.
+
+The synchronous fit loop pays for every piece of host work — drawing
+per-node indices, ``take`` gathers, the [K, S, ...] multi-step stacking,
+and the ``device_put`` transfer — on the dispatch critical path while the
+accelerator idles. ``HostPrefetcher`` moves all of it onto a worker
+thread running one dispatch ahead: while dispatch N executes on device,
+the batch for dispatch N+1 is assembled into a preallocated host buffer
+(no per-leaf ``np.stack`` churn) and transferred, so ``multi_step(state,
+batch)`` always finds its input already resident. The queue is bounded
+(double-buffered: one batch held by the consumer, one in flight), so
+lookahead — and therefore host memory — stays constant.
+
+Determinism contract (pinned by ``tests/test_prefetch.py``): the worker
+draws batches from the SAME ``NodeBatchIterator`` in the SAME order as
+the synchronous loop would, so seeded permutations, epoch boundaries and
+batch contents are bit-identical with prefetch on or off. Each queue
+item carries a snapshot of the iterator state taken right after its
+batch was drawn; ``consumed_state()`` returns the snapshot of the last
+batch the trainer actually consumed — exactly what ``train_iter.state()``
+would read in the synchronous loop — so checkpoint/resume is oblivious
+to how far ahead the worker has run.
+"""
+
+from __future__ import annotations
+
+import copy
+import queue
+import threading
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+_SENTINEL_ERROR = "__prefetch_error__"
+_SENTINEL_DONE = "__prefetch_done__"
+
+
+def _transfers_copy() -> bool:
+    """Does ``device_put`` copy host memory (vs aliasing the numpy buffer)?
+
+    TPU/GPU transfers always copy into device memory, so a host buffer
+    may be refilled once the transfer has completed. The CPU backend
+    zero-copies SOME suitably-aligned numpy arrays — observed: an int32
+    leaf aliased while its sibling float32 leaf copied, within one
+    device_put of the same tree — so no per-process probe can clear
+    buffer reuse there; every batch must own its memory.
+    """
+    return jax.default_backend() != "cpu"
+
+
+def dispatch_schedule(start_step: int, max_steps: int, steps_per_call: int,
+                      has_multi: bool) -> List[int]:
+    """Steps consumed by each dispatch of the fit loop, in order — the
+    loop's ``s`` sequence made explicit so the prefetch worker can walk
+    it independently. Must mirror the fit loop's quantization exactly:
+    full calls run ``steps_per_call`` on the multi-step program, any
+    remainder falls back to single-step dispatches."""
+    sched = []
+    i = start_step
+    while i < max_steps:
+        s = min(steps_per_call, max_steps - i)
+        if s < steps_per_call or not has_multi:
+            s = 1
+        sched.append(s)
+        i += s
+    return sched
+
+
+class HostPrefetcher:
+    """Bounded background pipeline over a ``NodeBatchIterator``.
+
+    Parameters
+    ----------
+    train_iter: the iterator to draw from. After ``start()`` the worker
+        thread OWNS it — the caller must not touch it until ``close()``.
+    feed: host tree -> device tree (the Trainer's sharded ``device_put``
+        closure; multi-process safe since it only touches addressable
+        shards).
+    schedule: ``dispatch_schedule(...)`` — the s-value of every upcoming
+        dispatch.
+    n_micro, micro_bs, nodes: forwarded to ``next_batch``.
+    queue_depth: bounded lookahead (1 = classic double buffering: one
+        batch with the consumer, one staged).
+    """
+
+    def __init__(self, train_iter, feed: Callable,
+                 schedule: Sequence[int], *, n_micro: int, micro_bs: int,
+                 nodes: Optional[Sequence[int]] = None, queue_depth: int = 1):
+        self._iter = train_iter
+        self._feed = feed
+        self._schedule = list(schedule)
+        self._n_micro = n_micro
+        self._micro_bs = micro_bs
+        self._nodes = list(nodes) if nodes is not None else None
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, queue_depth))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._worker,
+                                        name="gym-tpu-prefetch", daemon=True)
+        self._consumed_state = copy.deepcopy(train_iter.state())
+        self._reuse_buffers = _transfers_copy()
+        self._buffers = {}  # s -> tuple of preallocated [K(,S),...] arrays
+        # field shapes/dtypes are discovered from the FIRST real draw —
+        # a probe `take` would advance stateful datasets (augmentation
+        # call counters) and break bit-identity with the sync path
+        self._field_meta = None
+        self._started = False
+
+    # -- worker side ------------------------------------------------------
+
+    def _acquire_buffers(self, s: int):
+        bufs = self._buffers.get(s) if self._reuse_buffers else None
+        if bufs is None:
+            if s > 1:
+                bufs = tuple(
+                    np.empty((shape[0], s) + shape[1:], dtype)
+                    for shape, dtype in self._field_meta)
+            else:
+                bufs = tuple(np.empty(shape, dtype)
+                             for shape, dtype in self._field_meta)
+            if self._reuse_buffers:
+                self._buffers[s] = bufs
+        return bufs
+
+    def _assemble(self, s: int):
+        """Draw s steps' worth of microbatch grids straight into the
+        preallocated buffer: [K, S, n_micro, micro_bs, ...] per field for
+        a multi-step dispatch, [K, n_micro, micro_bs, ...] for s == 1.
+
+        The very first draw runs through the allocating ``next_batch``
+        path to DISCOVER field shapes (one extra copy, once); every
+        later draw fills buffers in place."""
+        first = None
+        if self._field_meta is None:
+            first = self._iter.next_batch(self._n_micro, self._micro_bs,
+                                          nodes=self._nodes)
+            self._field_meta = [(a.shape, a.dtype) for a in first]
+            if s == 1:
+                return first
+        bufs = self._acquire_buffers(s)
+        if s > 1:
+            start = 0
+            if first is not None:
+                for f, a in zip(bufs, first):
+                    f[:, 0] = a
+                start = 1
+            for j in range(start, s):
+                self._iter.next_batch(
+                    self._n_micro, self._micro_bs, nodes=self._nodes,
+                    out=tuple(f[:, j] for f in bufs))
+        else:
+            self._iter.next_batch(self._n_micro, self._micro_bs,
+                                  nodes=self._nodes, out=bufs)
+        return bufs
+
+    def _put(self, item) -> bool:
+        """Bounded put that stays responsive to ``close()``."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _worker(self):
+        try:
+            for s in self._schedule:
+                if self._stop.is_set():
+                    return
+                host_batch = self._assemble(s)
+                state = copy.deepcopy(self._iter.state())
+                device_batch = self._feed(host_batch)
+                if self._reuse_buffers:
+                    # fence the H2D copy before the host buffer is
+                    # recycled on the next loop iteration; without reuse
+                    # each batch owns its memory and the fence would only
+                    # serialize the worker against the transfer
+                    jax.block_until_ready(device_batch)
+                if not self._put(("batch", device_batch, state)):
+                    return
+                del device_batch  # consumer owns it (it may be donated)
+            self._put((_SENTINEL_DONE, None, None))
+        except BaseException as e:  # noqa: BLE001 — must cross threads
+            self._put((_SENTINEL_ERROR, e, None))
+
+    # -- consumer side ----------------------------------------------------
+
+    def start(self) -> "HostPrefetcher":
+        self._thread.start()
+        self._started = True
+        return self
+
+    def get(self):
+        """Next device-resident batch, in schedule order. Re-raises any
+        worker-side exception in the caller's thread."""
+        while True:
+            try:
+                item = self._q.get(timeout=1.0)
+                break
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    raise RuntimeError(
+                        "prefetch worker died without reporting an error")
+        tag, batch, state = item
+        if tag == _SENTINEL_ERROR:
+            self._stop.set()
+            raise batch
+        if tag == _SENTINEL_DONE:
+            raise RuntimeError("prefetch schedule exhausted")
+        self._consumed_state = state
+        return batch
+
+    def consumed_state(self) -> dict:
+        """Iterator state as-if the consumed batches had been drawn
+        synchronously — the checkpointable position, independent of
+        worker lookahead."""
+        return self._consumed_state
+
+    def close(self) -> None:
+        """Idempotent shutdown: unblocks and joins the worker even when
+        the fit loop exits early (exception, max_steps reached with
+        batches still staged)."""
+        self._stop.set()
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        if self._started and self._thread.is_alive():
+            self._thread.join(timeout=10.0)
+
+    def __enter__(self) -> "HostPrefetcher":
+        return self if self._started else self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -163,7 +163,7 @@ class NodeBatchIterator:
             need -= take
         return np.concatenate(out) if len(out) > 1 else out[0]
 
-    def next_batch(self, n_micro: int, micro_bs: int, nodes=None):
+    def next_batch(self, n_micro: int, micro_bs: int, nodes=None, out=None):
         """Fetch [K, n_micro, micro_bs, ...] arrays for one step.
 
         ``nodes``: in a multi-process world each host passes ITS node
@@ -172,7 +172,13 @@ class NodeBatchIterator:
         still advances so epoch boundaries and the checkpointable
         iterator state stay identical on every host (the property that
         makes per-host data loading scale — reference
-        ``DistributedSampler`` semantics at host granularity)."""
+        ``DistributedSampler`` semantics at host granularity).
+
+        ``out``: optional tuple of preallocated arrays (one per field,
+        shaped [len(order), n_micro, micro_bs, ...]) filled in place —
+        the prefetcher's assembly path, which skips the per-field
+        ``np.stack`` allocation. Values written are identical to the
+        allocating path's."""
         wanted = set(range(self.num_nodes) if nodes is None else nodes)
         order = list(range(self.num_nodes)) if nodes is None else list(nodes)
         per_node = {}
@@ -185,6 +191,11 @@ class NodeBatchIterator:
                 a.reshape((n_micro, micro_bs) + a.shape[1:]) for a in arrs
             )
         n_fields = len(next(iter(per_node.values())))
+        if out is not None:
+            for j in range(n_fields):
+                for row, n in enumerate(order):
+                    out[j][row] = per_node[n][j]
+            return tuple(out)
         return tuple(
             np.stack([per_node[n][j] for n in order])
             for j in range(n_fields)

@@ -25,6 +25,8 @@ from typing import Any, Optional, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+
+from ..parallel.axis import axis_size as _axis_size
 import numpy as np
 import optax
 
@@ -378,7 +380,7 @@ def slice_seq_chunk(idx, targets, seq_axis: str, axis: int = 1,
     psum'd sum/count reduction). Falls back to contiguous when ``Tl`` is
     odd — the same static condition the attention dispatch tests, so the
     two sides can never disagree."""
-    sp = jax.lax.axis_size(seq_axis)
+    sp = _axis_size(seq_axis)
     t = idx.shape[axis]
     assert t % sp == 0, f"seq len {t} not divisible by cp={sp}"
     tl = t // sp

@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .axis import axis_size
+
 PIPE_AXIS = "pipe"
 
 
@@ -71,8 +73,8 @@ def pipeline_apply(
     double-counting the tied embedding: see
     ``train_node.make_pipeline_train_step``).
     """
-    assert jax.lax.axis_size(axis_name) == n_stages, (
-        f"pipe axis '{axis_name}' has size {jax.lax.axis_size(axis_name)} "
+    assert axis_size(axis_name) == n_stages, (
+        f"pipe axis '{axis_name}' has size {axis_size(axis_name)} "
         f"but n_stages={n_stages}: a mismatch would make the is_last mask "
         "never fire and the masked psum return silent zeros"
     )
@@ -111,9 +113,12 @@ def pipeline_apply(
     if hasattr(lax, "pcast"):
         def _vary(x):
             return lax.pcast(x, (axis_name,), to="varying")
-    else:  # pragma: no cover — older JAX
+    elif hasattr(lax, "pvary"):  # pragma: no cover — pre-pcast JAX
         def _vary(x):
             return lax.pvary(x, (axis_name,))
+    else:  # jax 0.4.x: no VMA typing — the annotation is a no-op
+        def _vary(x):
+            return x
     out0 = _vary(jnp.zeros_like(xs))
     inbox0 = _vary(jnp.zeros_like(xs[0]))
     aux0 = _vary(jnp.zeros((), jnp.float32))

@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .axis import axis_size
+
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
@@ -104,7 +106,7 @@ def _ring_kernel_blocks_zigzag(q, k, v, axis_name: str) -> jnp.ndarray:
     Differentiable end-to-end (fused kernels expose lse cotangents)."""
     from ..ops.fused_attention import fused_block_attention
 
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
     h = q.shape[-2] // 2
@@ -158,7 +160,7 @@ def _ring_dense_zigzag(q, k, v, axis_name: str, dropout_rate: float,
     a gated block contributes via ``m = -1e30`` ⇒ weight 0. Dropout draws
     one fold per (ring step, block) — statistically equivalent to, but not
     bitwise the same as, the contiguous schedule's draws."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
     h = q.shape[-2] // 2
@@ -228,7 +230,7 @@ def _ring_kernel_blocks(q, k, v, axis_name: str) -> jnp.ndarray:
     merge is the exact ring backward."""
     from ..ops.fused_attention import fused_block_attention
 
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -292,7 +294,7 @@ def ring_causal_attention(
     into zig-zag halves and falls back to the contiguous schedule — the
     slicing side makes the same static decision.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     drop = dropout_rate > 0.0 and not deterministic
     if n == 1:
         from ..ops.flash_attention import flash_causal_attention
@@ -351,9 +353,12 @@ def ring_causal_attention(
     if hasattr(lax, "pcast"):
         def _vary(x):
             return lax.pcast(x, (axis_name,), to="varying")
-    else:  # pragma: no cover — older JAX
+    elif hasattr(lax, "pvary"):  # pragma: no cover — pre-pcast JAX
         def _vary(x):
             return lax.pvary(x, (axis_name,))
+    else:  # jax 0.4.x: no VMA typing — the annotation is a no-op
+        def _vary(x):
+            return x
     o0 = _vary(jnp.zeros((b_, h_, tl, d_), jnp.float32))
     m0 = _vary(jnp.full((b_, h_, tl, 1), -1e30, jnp.float32))
     l0 = _vary(jnp.zeros((b_, h_, tl, 1), jnp.float32))

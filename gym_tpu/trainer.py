@@ -12,12 +12,14 @@ source compatibility.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
 
+from .data.prefetch import HostPrefetcher, dispatch_schedule
 from .data.sampler import NodeBatchIterator, resolve_node_datasets
 from .models.base import LossModel, as_loss_model
 from .parallel.mesh import NodeRuntime
@@ -43,6 +45,10 @@ class FitResult:
     final_train_loss: float
     history: Dict[str, List]
     mfu: Optional[float] = None   # model-FLOPs utilization (GPT models)
+    # throughput excluding the first dispatch (compile/warmup): the number
+    # an A/B of loop mechanics (e.g. bench.py's host_overlap ablation)
+    # should compare. None when the run had fewer than two dispatches.
+    steps_per_second_steady: Optional[float] = None
 
 
 def _model_config(module) -> Dict[str, Any]:
@@ -156,6 +162,9 @@ class Trainer:
         skip_nonfinite: bool = False,
         correlation_interval: Optional[int] = None,
         steps_per_call: int = 1,
+        prefetch: bool = True,
+        async_checkpoint: bool = True,
+        compilation_cache_dir: Optional[str] = None,
         profile_dir: Optional[str] = None,
         checkpoint_interval: Optional[int] = None,
         save_dir: Optional[str] = None,
@@ -170,6 +179,12 @@ class Trainer:
         assert strategy is not None, "fit requires a strategy"
         if extra:
             raise TypeError(f"Unknown fit() kwargs: {sorted(extra)}")
+        if compilation_cache_dir is not None or os.environ.get(
+                "JAX_COMPILATION_CACHE_DIR"):
+            # persistent XLA compile cache: repeated fits of the same
+            # program (bench reruns, checkpoint resumes) skip warmup
+            from .utils.compile_cache import enable_compilation_cache
+            enable_compilation_cache(compilation_cache_dir)
         if val_interval and steps_per_call > val_interval:
             # at most one eval fires per dispatch, so eval frequency would
             # silently drop to once per call (ADVICE r1)
@@ -410,9 +425,12 @@ class Trainer:
         ckpt = None
         start_step = 0
         to_canon = from_canon = None
+        # overlapped saves need a single-process world (multi-process Orbax
+        # writes are collective) — the writer thread is gated accordingly
+        ckpt_overlap = async_checkpoint and not multi
         if save_dir is not None and checkpoint_interval:
             ckpt = CheckpointManager(save_dir, run_name or "default",
-                                     async_save=not multi)
+                                     async_save=ckpt_overlap)
             if pipe_model is not None:
                 import jax.sharding as _shd
                 from jax.sharding import NamedSharding
@@ -456,7 +474,8 @@ class Trainer:
                                              runtime.ctx, skip_nonfinite,
                                              param_specs)
             io_specs = dict(in_specs=(state_specs, P(NODE_AXIS)),
-                            out_specs=(state_specs, P(NODE_AXIS)))
+                            out_specs=(state_specs, P(NODE_AXIS)),
+                            donate_batch=True)
             train_step = runtime.compile(pstep, **io_specs)
             multi_step = None
             if steps_per_call > 1:
@@ -474,13 +493,15 @@ class Trainer:
         else:
             train_step = runtime.compile(
                 make_train_step(loss_model, strategy, runtime.ctx,
-                                param_specs, skip_nonfinite)
+                                param_specs, skip_nonfinite),
+                donate_batch=True,
             )
             multi_step = None
             if steps_per_call > 1:
                 multi_step = runtime.compile(
                     make_multi_train_step(loss_model, strategy, runtime.ctx,
-                                          param_specs, skip_nonfinite)
+                                          param_specs, skip_nonfinite),
+                    donate_batch=True,
                 )
             # Eval in f32 regardless of autocast (VERDICT r2 weak #3): a
             # bf16 eval of a converged model measures rounding noise —
@@ -538,7 +559,18 @@ class Trainer:
             corr_jit = jax.jit(_corr_moments,
                                out_shardings=runtime.replicated_sharding)
 
-        def log_correlation():
+        # Deferred host fetches (host-overlap discipline): eval and
+        # correlation DISPATCH immediately but their device→host fetch is
+        # queued and drained only after the next train dispatch is in
+        # flight — the same 1-call-lag overlap the train metrics use, so
+        # an interval firing never stalls the device.
+        pending_host: List = []
+
+        def drain_host():
+            while pending_host:
+                pending_host.pop(0)()
+
+        def log_correlation(defer: bool = False):
             # Replica-correlation observable (the one reference observable
             # with no analog here until round 3): mean pairwise Pearson
             # correlation of the flattened per-node parameter vectors —
@@ -546,11 +578,17 @@ class Trainer:
             # `exogym/train_node.py:498-571`, without its
             # checkpoint-to-disk round trip: params already carry the
             # node axis. Moments on device, K² scalars to host (r3 #7).
-            v = _replica_correlation(np.asarray(corr_jit(state.params)))
-            logger.log_loss(v, "correlation")
-            history["avg_model_correlation"].append((logger.step, v))
+            moments = corr_jit(state.params)
+            step_at = logger.step
 
-        def run_eval():
+            def fetch(moments=moments, step_at=step_at):
+                v = _replica_correlation(np.asarray(moments))
+                logger.log_loss(v, "correlation", step=step_at)
+                history["avg_model_correlation"].append((step_at, v))
+
+            pending_host.append(fetch) if defer else fetch()
+
+        def run_eval(defer: bool = False):
             if val_iter is None:
                 return
             n_val_micro = max(1, val_size // minibatch_size)
@@ -561,20 +599,27 @@ class Trainer:
             local, glob = eval_step(state, vb)
             if replicate is not None:
                 local, glob = replicate((local, glob))
-            local = np.asarray(local)
-            glob = np.asarray(glob)
-            # Reference: "local" is rank 0's own replica, "global" is the
-            # averaged model evaluated on rank 1's stream
-            # (train_node.py:191-244).
-            logger.log_loss(float(local[0]), "local")
-            logger.log_loss(float(glob[min(1, num_nodes - 1)]), "global")
-            history["local_loss"].append((logger.step, float(local[0])))
-            history["global_loss"].append(
-                (logger.step, float(glob[min(1, num_nodes - 1)]))
-            )
+            step_at = logger.step
+
+            def fetch(local=local, glob=glob, step_at=step_at):
+                local_a = np.asarray(local)
+                glob_a = np.asarray(glob)
+                # Reference: "local" is rank 0's own replica, "global" is
+                # the averaged model evaluated on rank 1's stream
+                # (train_node.py:191-244).
+                lo = float(local_a[0])
+                gl = float(glob_a[min(1, num_nodes - 1)])
+                logger.log_loss(lo, "local", step=step_at)
+                logger.log_loss(gl, "global", step=step_at)
+                history["local_loss"].append((step_at, lo))
+                history["global_loss"].append((step_at, gl))
+
+            pending_host.append(fetch) if defer else fetch()
 
         pending = None  # (step_idx, metrics) — 1-step-lag fetch for overlap
-        t_start = time.time()
+        # perf_counter, not time.time: wall clock is not monotonic (NTP
+        # slews skew short bench windows)
+        t_start = time.perf_counter()
         last_loss = float("nan")
         logger.step = start_step
         if getattr(logger, "pbar", None) is not None and start_step:
@@ -621,69 +666,150 @@ class Trainer:
 
         # Profiling (SURVEY §5.1 — absent in the reference): capture an
         # XLA/TPU trace of a few post-warmup steps, viewable in
-        # TensorBoard / Perfetto.
+        # TensorBoard / Perfetto. Tracing is additionally gated on the
+        # first post-(re)start dispatch having RETIRED (its metrics
+        # drained): on a checkpoint resume whose start_step lands inside a
+        # previously traced window, a pure step-number gate would silently
+        # re-trace the recompile/warmup dispatches.
         profiling = False
+        profile_done = False
+        first_retired = False
+        t_steady = None
+        steady_from = start_step
         # window must contain a dispatch boundary: boundaries advance by
         # steps_per_call, so span at least one full call past warmup
         profile_start = start_step + 2
-        profile_stop = min(max_steps,
-                           profile_start + max(8, 2 * steps_per_call))
+        profile_stop = max_steps
+
+        # The dispatch schedule (each call's step count) is deterministic
+        # given (start_step, max_steps, steps_per_call) — precomputing it
+        # lets the prefetch worker assemble and device_put the batch for
+        # dispatch N+1 while dispatch N runs, so the device never waits
+        # on host-side input work.
+        sched = dispatch_schedule(start_step, max_steps, steps_per_call,
+                                  multi_step is not None)
+        prefetcher = None
+        if prefetch and sched:
+            prefetcher = HostPrefetcher(
+                train_iter, feed, sched, n_micro=n_micro,
+                micro_bs=minibatch_size, nodes=local_nodes,
+            ).start()
+
+        snap_jit = None
+        if ckpt is not None and ckpt_overlap and to_canon is None:
+            import jax.numpy as jnp
+            # device-side copy: the live state's buffers are donated to
+            # the very next dispatch, so the writer thread snapshots a
+            # COPY (enqueued before the donating call, hence ordered)
+            snap_jit = jax.jit(
+                lambda t: jax.tree.map(jnp.copy, t))
+
+        def save_checkpoint(at_step: int) -> None:
+            # with prefetch, the worker has drawn AHEAD of the consumed
+            # position — consumed_state() is the synchronous-equivalent
+            # iterator state for the batches actually dispatched
+            data_state = (prefetcher.consumed_state()
+                          if prefetcher is not None else train_iter.state())
+            canon = to_canon(state) if to_canon is not None else None
+            if not ckpt_overlap:
+                # serial save: multi-process lockstep write, or the
+                # async_checkpoint=False escape hatch (and the bench
+                # ablation's overlap-off arm)
+                ckpt.save(at_step, canon if canon is not None else state,
+                          data_state)
+            else:
+                # overlapped save: device-side snapshot now, device_get +
+                # write on the checkpoint writer thread (canonical
+                # conversion already materialized fresh buffers)
+                ckpt.save_async(
+                    at_step,
+                    canon if canon is not None else snap_jit(state),
+                    data_state)
 
         step_idx = start_step
-        while step_idx < max_steps:
-            if profile_dir and not profiling and step_idx >= profile_start \
-                    and step_idx < profile_stop:
-                jax.profiler.start_trace(profile_dir)
-                profiling = True
-            if profiling and step_idx >= profile_stop:
-                jax.profiler.stop_trace()
-                profiling = False
-            s = min(steps_per_call, max_steps - step_idx)
-            if s < steps_per_call or multi_step is None:
-                s = 1  # remainder runs on the single-step program
-            # interval firings happen at dispatch boundaries (with
-            # steps_per_call > 1 the boundary is quantized to the call
-            # that contains it)
-            if _due(val_interval, step_idx, s):
+        try:
+            for s in sched:
+                if profile_dir and not profile_done:
+                    if profiling and step_idx >= profile_stop:
+                        jax.profiler.stop_trace()
+                        profiling = False
+                        profile_done = True
+                    elif (not profiling and first_retired
+                          and step_idx >= profile_start):
+                        jax.profiler.start_trace(profile_dir)
+                        profiling = True
+                        profile_stop = min(max_steps,
+                                           step_idx + max(8, 2 * s))
+                # interval firings happen at dispatch boundaries (with
+                # steps_per_call > 1 the boundary is quantized to the call
+                # that contains it); their host fetches are deferred past
+                # the next dispatch (drain_host below)
+                if _due(val_interval, step_idx, s):
+                    run_eval(defer=True)
+                if _due(correlation_interval, step_idx, s):
+                    log_correlation(defer=True)
+                if s > 1:
+                    if prefetcher is not None:
+                        batch = prefetcher.get()
+                    else:
+                        stacked = [train_iter.next_batch(
+                            n_micro, minibatch_size, nodes=local_nodes)
+                            for _ in range(s)]
+                        batch = feed(jax.tree.map(
+                            lambda *xs: np.stack(xs, axis=1), *stacked))
+                    state, metrics = multi_step(state, batch)
+                else:
+                    if prefetcher is not None:
+                        batch = prefetcher.get()
+                    else:
+                        batch = feed(
+                            train_iter.next_batch(n_micro, minibatch_size,
+                                                  nodes=local_nodes))
+                    state, metrics = train_step(state, batch)
                 if pending is not None:
                     drain(pending)
-                    pending = None
-                run_eval()
-            if _due(correlation_interval, step_idx, s):
-                log_correlation()
-            if s > 1:
-                stacked = [train_iter.next_batch(n_micro, minibatch_size,
-                                                 nodes=local_nodes)
-                           for _ in range(s)]
-                batches = jax.tree.map(
-                    lambda *xs: np.stack(xs, axis=1), *stacked
-                )
-                state, metrics = multi_step(state, feed(batches))
-            else:
-                batch = feed(
-                    train_iter.next_batch(n_micro, minibatch_size,
-                                          nodes=local_nodes)
-                )
-                state, metrics = train_step(state, batch)
-            if pending is not None:
-                drain(pending)
-            pending = (step_idx, metrics, s)
-            for _ in range(s):
-                logger.increment_step()
-            prev_idx, step_idx = step_idx, step_idx + s
-            if ckpt is not None and (
-                step_idx // checkpoint_interval > prev_idx // checkpoint_interval
-            ):
-                ckpt.save(step_idx,
-                          to_canon(state) if to_canon else state,
-                          train_iter.state())
+                    if not first_retired:
+                        # steady-state clock starts once the first dispatch
+                        # (which absorbed the compiles) has retired;
+                        # step_idx still reads this iteration's start step
+                        first_retired = True
+                        t_steady = time.perf_counter()
+                        steady_from = step_idx
+                drain_host()
+                pending = (step_idx, metrics, s)
+                for _ in range(s):
+                    logger.increment_step()
+                prev_idx, step_idx = step_idx, step_idx + s
+                if ckpt is not None and (
+                    step_idx // checkpoint_interval
+                    > prev_idx // checkpoint_interval
+                ):
+                    save_checkpoint(step_idx)
+        except BaseException:
+            # shut the checkpoint writer down without masking the original
+            # error; the prefetch worker is closed in the finally below
+            if ckpt is not None:
+                try:
+                    ckpt.close()
+                except Exception:
+                    pass
+            raise
+        finally:
+            if prefetcher is not None:
+                prefetcher.close()
 
         if pending is not None:
             drain(pending)
+        drain_host()
         if profiling:
             jax.profiler.stop_trace()
         jax.block_until_ready(state.params)
-        elapsed = time.time() - t_start
+        t_end = time.perf_counter()
+        elapsed = t_end - t_start
+        sps_steady = None
+        if t_steady is not None and max_steps > steady_from \
+                and t_end > t_steady:
+            sps_steady = (max_steps - steady_from) / (t_end - t_steady)
         steps_done = max_steps - start_step
 
         # MFU (VERDICT r1: estimate_mfu existed but nothing called it — the
@@ -718,9 +844,7 @@ class Trainer:
         run_eval()
         if ckpt is not None:
             if max_steps % checkpoint_interval != 0 and max_steps > start_step:
-                ckpt.save(max_steps,
-                          to_canon(state) if to_canon else state,
-                          train_iter.state())
+                save_checkpoint(max_steps)
             ckpt.close()
         logger.close()
 
@@ -762,6 +886,7 @@ class Trainer:
             final_train_loss=last_loss,
             history=history,
             mfu=mfu,
+            steps_per_second_steady=sps_steady,
         )
 
 

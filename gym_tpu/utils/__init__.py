@@ -1,3 +1,5 @@
+from .compile_cache import enable_compilation_cache
 from .logger import CSVLogger, Logger, WandbLogger
 
-__all__ = ["CSVLogger", "Logger", "WandbLogger"]
+__all__ = ["CSVLogger", "Logger", "WandbLogger",
+           "enable_compilation_cache"]

@@ -9,16 +9,30 @@ skip, and keep-latest-only pruning.
 
 This module implements that surface TPU-native with Orbax: ONE checkpoint
 per step for the whole K-node mesh (the per-node axis is just the leading
-dimension of every array), async save so the TPU never waits on disk,
-atomic finalization (replaces the reference's corrupt-zipfile handling),
-``max_to_keep`` pruning, and the data-iterator position + logger step saved
-alongside the device state — the two pieces the reference's fast-forward
-hack (``train_node.py:444-474``) approximated.
+dimension of every array), ``max_to_keep`` pruning, atomic finalization
+(replaces the reference's corrupt-zipfile handling), and the data-iterator
+position + logger step saved alongside the device state — the two pieces
+the reference's fast-forward hack (``train_node.py:444-474``) approximated.
+
+Saves come in two flavors:
+
+- ``save``: synchronous — device→host fetch and the Orbax write both run
+  on the caller's thread. Required in a multi-process world (every process
+  must participate in the write in lockstep).
+- ``save_async``: the overlapped path the Trainer uses single-process. The
+  caller hands over a device-side SNAPSHOT (fresh buffers — the Trainer
+  jits a ``jnp.copy`` of the state, so the live state can be donated to
+  the very next dispatch) and returns immediately; a writer thread does
+  the blocking ``jax.device_get`` and the Orbax write off the dispatch
+  critical path. If a newer save arrives while one is still being
+  written, the older PENDING save is coalesced away (the in-flight write
+  completes) — checkpoints are recovery points, the newest wins.
 """
 
 from __future__ import annotations
 
 import os
+import threading
 from typing import Any, Optional, Tuple
 
 import jax
@@ -37,10 +51,11 @@ class CheckpointManager:
 
     def __init__(self, save_dir: str, run_name: str, max_to_keep: int = 1,
                  async_save: bool = True):
-        """``async_save=False`` forces synchronous saves — required in a
-        multi-process world, where Orbax's async finalize (process-0
-        metadata commit after every process's write) races max_to_keep
-        pruning of the tmp dir; the Trainer passes it automatically."""
+        """``async_save=True`` enables the ``save_async`` writer thread;
+        ``False`` forces every save synchronous — required in a
+        multi-process world, where a background write on one process
+        would race the collective write protocol; the Trainer passes it
+        automatically."""
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
@@ -51,14 +66,25 @@ class CheckpointManager:
             path,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
-                enable_async_checkpointing=async_save,
+                # Orbax's own async path still blocks the caller on the
+                # device→host copy; our writer thread moves that off the
+                # critical path too, so the underlying writes stay sync.
+                enable_async_checkpointing=False,
                 create=True,
             ),
         )
+        self._async = async_save
+        self._writer: Optional[threading.Thread] = None
+        self._work = threading.Condition()
+        self._pending: Optional[tuple] = None
+        self._inflight = False
+        self._closing = False
+        self._writer_error: Optional[BaseException] = None
 
-    def save(self, step: int, state: PyTree, data_state: dict,
-             extra: Optional[dict] = None) -> None:
-        """Async save of device state + host-side progress metadata."""
+    # -- writes -----------------------------------------------------------
+
+    def _write(self, step: int, state: PyTree, data_state: dict,
+               extra: Optional[dict]) -> None:
         ocp = self._ocp
         meta = {"data_state": data_state, "extra": extra or {}}
         self.manager.save(
@@ -68,6 +94,69 @@ class CheckpointManager:
                 meta=ocp.args.JsonSave(meta),
             ),
         )
+
+    def save(self, step: int, state: PyTree, data_state: dict,
+             extra: Optional[dict] = None) -> None:
+        """Synchronous save of device state + host-side progress metadata.
+
+        State goes to Orbax as-is: in a multi-process world the arrays are
+        non-addressable global shards that only Orbax's collective write
+        protocol may fetch (a ``device_get`` here would raise)."""
+        self.wait()  # serialize with any in-flight async write
+        self._write(step, state, data_state, extra)
+
+    def save_async(self, step: int, state_snapshot: PyTree, data_state: dict,
+                   extra: Optional[dict] = None) -> None:
+        """Enqueue a save and return immediately (writer-thread mode).
+
+        ``state_snapshot`` must be device arrays the caller will NOT
+        mutate or donate afterwards — hand over a fresh device-side copy,
+        not the live training state. The writer thread performs the
+        ``device_get`` and the Orbax write; a still-PENDING older save is
+        replaced (newest-wins coalescing) so the queue depth — and the
+        HBM pinned by staged snapshots — is bounded at one pending plus
+        one in flight.
+        """
+        if not self._async:
+            self.save(step, state_snapshot, data_state, extra)
+            return
+        with self._work:
+            self._raise_writer_error()
+            if self._writer is None:
+                self._writer = threading.Thread(
+                    target=self._writer_loop, name="gym-tpu-ckpt-writer",
+                    daemon=True)
+                self._writer.start()
+            self._pending = (step, state_snapshot, data_state, extra)
+            self._work.notify_all()
+
+    def _writer_loop(self) -> None:
+        while True:
+            with self._work:
+                while self._pending is None and not self._closing:
+                    self._work.wait()
+                if self._pending is None:
+                    return
+                item, self._pending = self._pending, None
+                self._inflight = True
+            try:
+                step, snapshot, data_state, extra = item
+                host_state = jax.device_get(snapshot)
+                del snapshot  # release the device-side copy promptly
+                self._write(step, host_state, data_state, extra)
+            except BaseException as e:  # noqa: BLE001 — surfaced on wait()
+                self._writer_error = e
+            finally:
+                with self._work:
+                    self._inflight = False
+                    self._work.notify_all()
+
+    def _raise_writer_error(self) -> None:
+        if self._writer_error is not None:
+            e, self._writer_error = self._writer_error, None
+            raise RuntimeError("async checkpoint write failed") from e
+
+    # -- reads / lifecycle ------------------------------------------------
 
     def latest_step(self) -> Optional[int]:
         return self.manager.latest_step()
@@ -96,9 +185,20 @@ class CheckpointManager:
         )
 
     def wait(self) -> None:
-        """Block until pending async saves are durable."""
+        """Block until every enqueued save is durable."""
+        with self._work:
+            while self._pending is not None or self._inflight:
+                self._work.wait()
+            self._raise_writer_error()
         self.manager.wait_until_finished()
 
     def close(self) -> None:
+        with self._work:
+            self._closing = True
+            self._work.notify_all()
+        if self._writer is not None:
+            self._writer.join(timeout=600.0)
+        with self._work:
+            self._raise_writer_error()
         self.manager.wait_until_finished()
         self.manager.close()

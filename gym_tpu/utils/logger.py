@@ -46,10 +46,15 @@ class Logger:
                 comm=_fmt_bytes(self.cum_comm_bytes),
             )
 
-    def log_loss(self, loss: float, name: str) -> None:
+    def log_loss(self, loss: float, name: str,
+                 step: Optional[int] = None) -> None:
+        """``step`` pins the record to the step the value was COMPUTED at —
+        the fit loop defers eval/correlation host fetches past the next
+        dispatch (host-overlap), by which time ``self.step`` has moved on."""
+        at = self.step if step is None else step
         if self.pbar is not None:
             self.pbar.write(
-                f"step {self.step}: {name} loss {loss:.4f} "
+                f"step {at}: {name} loss {loss:.4f} "
                 f"(ppl {math.exp(min(loss, 20.0)):.2f})"
             )
 
@@ -92,7 +97,8 @@ class NullLogger(Logger):
     def __init__(self, max_steps: int):
         super().__init__(max_steps, show_progress=False)
 
-    def log_loss(self, loss: float, name: str) -> None:
+    def log_loss(self, loss: float, name: str,
+                 step: Optional[int] = None) -> None:
         pass
 
     def log_event(self, msg: str) -> None:
@@ -138,10 +144,10 @@ class CSVLogger(Logger):
              f"{self.cum_comm_bytes:.0f}"]
         )
 
-    def log_loss(self, loss, name):
-        super().log_loss(loss, name)
+    def log_loss(self, loss, name, step=None):
+        super().log_loss(loss, name, step)
         self._val_w.writerow(
-            [self.step, name, f"{loss:.6f}",
+            [self.step if step is None else step, name, f"{loss:.6f}",
              f"{math.exp(min(loss, 20.0)):.4f}"]
         )
         self._val_f.flush()
@@ -193,13 +199,13 @@ class WandbLogger(Logger):
                 step=self.step,
             )
 
-    def log_loss(self, loss, name):
-        super().log_loss(loss, name)
+    def log_loss(self, loss, name, step=None):
+        super().log_loss(loss, name, step)
         if self._run is not None:
             self._run.log(
                 {f"{name}/loss": loss,
                  f"{name}/perplexity": math.exp(min(loss, 20.0))},
-                step=self.step,
+                step=self.step if step is None else step,
             )
 
     def log_summary(self, summary):

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Tier-1 gate — the EXACT command from ROADMAP.md, so builders and CI run
+# the identical check. CPU-only (JAX_PLATFORMS=cpu; conftest.py adds the
+# 16-virtual-device layout), quick suite (-m 'not slow'), survives
+# collection errors, prints DOTS_PASSED=<n> for trend tracking.
+#
+# Usage: scripts/ci_tier1.sh   (from the repo root or anywhere)
+set -o pipefail
+cd "$(dirname "$0")/.."
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+exit $rc

@@ -187,7 +187,11 @@ def _count_all_gathers(strat, num_nodes, params_np):
     fn = rt.compile(lambda p, s, g, t: strat.step(g, p, s, t, rt.ctx),
                     donate_state=False)
     hlo = fn.lower(params, state, grads, tvec).compile().as_text()
-    return hlo.count("all-gather")
+    # count all-gather OP DEFINITIONS ("... = <ty> all-gather(...)") — a
+    # plain substring count also hits fusion operand lists that repeat
+    # the producing op's name (older XLA text dumps do this)
+    import re
+    return len(re.findall(r"=\s+\S+\s+all-gather", hlo))
 
 
 def test_demo_collective_count_independent_of_depth():

@@ -274,12 +274,21 @@ def test_moe_expert_parallel_matches_single_device():
     def loss_ep(p):
         return model_ep.apply({"params": p}, batch, train=False)
 
-    with jax.sharding.set_mesh(mesh):
+    # jax >= 0.6 spells the ambient-mesh context jax.sharding.set_mesh;
+    # on 0.4.x entering the Mesh itself binds the resource env that
+    # with_sharding_constraint resolves axis names against
+    _set_mesh = getattr(jax.sharding, "set_mesh", None)
+    with (_set_mesh(mesh) if _set_mesh is not None else mesh):
         ep = float(jax.jit(loss_ep)(sharded_params))
-    np.testing.assert_allclose(ep, base, rtol=1e-5, atol=1e-6)
+    # rtol 2e-5: the EP partition reduces the combine in a different
+    # order than the unsharded program; the drift is reduction-order
+    # float noise, observed up to ~1.2e-5 relative on CPU XLA
+    np.testing.assert_allclose(ep, base, rtol=2e-5, atol=1e-6)
 
 
 import functools
+
+from conftest import needs_partial_auto
 
 
 @functools.lru_cache(maxsize=8)  # the (1,1,1) baseline is shared by cases
@@ -317,6 +326,7 @@ def _fit_moe_losses(tp: int, ep: int, cp: int = 1):
 @pytest.mark.parametrize("tp,ep,cp", [(1, 2, 1), (2, 2, 1), (1, 2, 2),
                                       (2, 2, 2)])  # 4-axis: needs 16 devs
 @pytest.mark.slow
+@needs_partial_auto
 def test_moe_fit_sharded_matches_unsharded(tp, ep, cp):
     """Trainer-level expert parallelism — fit(ep=2) on a ('node','expert')
     mesh — plus the hybrid TP×EP ('node','model','expert'), CP×EP

@@ -1,0 +1,302 @@
+"""Host-overlap pipeline (ISSUE 1): the background prefetcher must be an
+EXECUTION detail — bit-identical training to the synchronous path — and the
+async checkpoint writer must keep the dispatch loop moving while a save is
+in flight.
+
+Oracles:
+- determinism: identical loss history for a fixed seed with prefetch on vs
+  off, across steps_per_call shapes (incl. the remainder schedule);
+- clean shutdown: a worker-side exception surfaces in the caller and no
+  threads leak; a consumer-side exception mid-fit tears the worker down;
+- checkpoint/resume mid-epoch with prefetch on: the saved iterator state
+  is the consumed position, not the worker's read-ahead position;
+- async save: ``save_async`` returns while the write is still in flight,
+  and the written checkpoint equals the snapshot at enqueue time even
+  though training (donation!) kept mutating the live state.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from gym_tpu import Trainer
+from gym_tpu.data import ArrayDataset
+from gym_tpu.data.prefetch import HostPrefetcher, dispatch_schedule
+from gym_tpu.data.sampler import NodeBatchIterator, resolve_node_datasets
+from gym_tpu.strategy import (DiLoCoStrategy, OptimSpec,
+                              SimpleReduceStrategy)
+
+from test_trainer_e2e import TinyLossModel, blobs
+
+
+def _fit(ds, *, prefetch, spc=1, max_steps=7, seed=3, val=None, **kw):
+    return Trainer(TinyLossModel(), ds, val).fit(
+        strategy=SimpleReduceStrategy(OptimSpec("adamw", lr=1e-3)),
+        num_nodes=8, max_steps=max_steps, batch_size=32, minibatch_size=16,
+        steps_per_call=spc, val_size=16 if val is not None else 0,
+        val_interval=3 if val is not None else 0, show_progress=False,
+        seed=seed, prefetch=prefetch, log_dir="/tmp/gym_tpu_test_logs", **kw)
+
+
+def _losses(res):
+    return [l for _, l in res.history["train_loss"]]
+
+
+def test_dispatch_schedule_mirrors_loop_quantization():
+    # full calls on the multi-step program, remainder as single steps
+    assert dispatch_schedule(0, 10, 4, True) == [4, 4, 1, 1]
+    assert dispatch_schedule(2, 10, 4, True) == [4, 4]
+    assert dispatch_schedule(0, 10, 4, False) == [1] * 10
+    assert dispatch_schedule(0, 0, 4, True) == []
+    assert sum(dispatch_schedule(3, 29, 5, True)) == 26
+
+
+@pytest.mark.parametrize("spc,max_steps", [(1, 7), (4, 12), (4, 10)])
+def test_prefetch_bit_identical_to_sync(spc, max_steps):
+    """The determinism contract: same seed → bit-identical loss history
+    with the prefetcher on or off ((4, 10) exercises the remainder
+    schedule, where the tail runs on the single-step program)."""
+    ds = blobs(512)
+    off = _fit(ds, prefetch=False, spc=spc, max_steps=max_steps)
+    on = _fit(ds, prefetch=True, spc=spc, max_steps=max_steps)
+    assert _losses(off) == _losses(on)
+
+
+def test_prefetch_stateful_dataset_stream_identical():
+    """A dataset whose output depends on its take-call COUNTER (the
+    augmentation-stream pattern, offline.CropAugmentedDataset): the
+    prefetcher must issue the exact same call sequence as the sync path —
+    no probe takes, no extra draws — or the streams diverge."""
+
+    class CountingAugDataset:
+        def __init__(self, n=256):
+            self.inner = blobs(n)
+            self.calls = 0
+
+        def __len__(self):
+            return len(self.inner)
+
+        def take(self, idx):
+            self.calls += 1
+            x, y = self.inner.take(idx)
+            # call-counter-dependent "augmentation"
+            return x + 0.01 * self.calls, y
+
+    off = _fit(CountingAugDataset(), prefetch=False, max_steps=6)
+    on = _fit(CountingAugDataset(), prefetch=True, max_steps=6)
+    assert _losses(off) == _losses(on)
+
+
+def test_prefetch_epoch_boundary_determinism():
+    """max_steps large enough that the iterator wraps epochs mid-run: the
+    worker must reshuffle at the same draw positions the sync path does."""
+    ds = blobs(128)  # 128 samples / (32 per step) = 4 steps per epoch
+    off = _fit(ds, prefetch=False, max_steps=11)
+    on = _fit(ds, prefetch=True, max_steps=11)
+    assert _losses(off) == _losses(on)
+
+
+def test_prefetch_worker_error_propagates_and_shuts_down():
+    """A dataset that raises inside the WORKER thread: the exception must
+    surface in the consumer's get(), and close() must leave no thread."""
+
+    class PoisonDataset:
+        def __init__(self, n=256):
+            self.inner = blobs(n)
+            self.calls = 0
+
+        def __len__(self):
+            return len(self.inner)
+
+        def take(self, idx):
+            self.calls += 1
+            if self.calls > 3:
+                raise RuntimeError("boom at draw 4")
+            return self.inner.take(idx)
+
+    dsets, sharded = resolve_node_datasets(PoisonDataset(), 2, is_val=False)
+    it = NodeBatchIterator(dsets, 2, sharded=sharded, shuffle=True, seed=0)
+    before = threading.active_count()
+    pf = HostPrefetcher(it, lambda t: jax.device_put(t),
+                        dispatch_schedule(0, 8, 1, False),
+                        n_micro=1, micro_bs=4).start()
+    with pytest.raises(RuntimeError, match="boom at draw 4"):
+        for _ in range(8):
+            pf.get()
+    pf.close()
+    pf.close()  # idempotent
+    assert threading.active_count() == before
+
+
+def test_fit_exception_cleans_up_threads(monkeypatch):
+    """A consumer-side exception mid-fit (here: poisoned metric drain) must
+    tear down the prefetch worker — no leaked threads, fit re-raises."""
+    import gym_tpu.trainer as trainer_mod
+
+    def poisoned(moments):
+        raise RuntimeError("drain poisoned")
+
+    monkeypatch.setattr(trainer_mod, "_replica_correlation", poisoned)
+    before = threading.active_count()
+    with pytest.raises(RuntimeError, match="drain poisoned"):
+        Trainer(TinyLossModel(), blobs(256)).fit(
+            strategy=SimpleReduceStrategy(OptimSpec("adamw", lr=1e-3)),
+            num_nodes=8, max_steps=6, batch_size=32, minibatch_size=16,
+            val_size=0, val_interval=0, correlation_interval=2,
+            show_progress=False, prefetch=True,
+            log_dir="/tmp/gym_tpu_test_logs")
+    # worker threads are join()ed by the finally; allow a beat for the OS
+    for _ in range(50):
+        if threading.active_count() <= before:
+            break
+        time.sleep(0.05)
+    assert threading.active_count() <= before
+
+
+def test_checkpoint_resume_mid_epoch_with_prefetch(tmp_path):
+    """Resume mid-epoch with prefetch ON equals the straight run: the
+    checkpoint must record the CONSUMED iterator position (the worker has
+    already drawn ahead when the save fires)."""
+    ds = blobs(256)  # epoch = 8 steps of 32; ckpt at 5 is mid-epoch
+
+    def fit(max_steps, tmp):
+        return _fit(ds, prefetch=True, max_steps=max_steps, seed=11,
+                    checkpoint_interval=5, save_dir=tmp,
+                    run_name="pf_resume")
+
+    straight = _fit(ds, prefetch=True, max_steps=9, seed=11)
+    fit(5, str(tmp_path))          # saves at step 5, mid-epoch
+    resumed = fit(9, str(tmp_path))
+    steps = [s for s, _ in resumed.history["train_loss"]]
+    assert min(steps) == 5 and max(steps) == 8  # genuinely resumed
+    for a, b in zip(jax.tree.leaves(straight.params),
+                    jax.tree.leaves(resumed.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-5)
+
+
+def test_prefetch_with_eval_and_correlation_interleaved():
+    """Interval firings (eval + correlation) with deferred host fetches:
+    values and steps must match the synchronous run exactly."""
+    ds = blobs(512)
+    val = blobs(64, seed=1)
+
+    def fit(prefetch):
+        return Trainer(TinyLossModel(), ds, val).fit(
+            strategy=DiLoCoStrategy(OptimSpec("adamw", lr=3e-2), H=5),
+            num_nodes=4, max_steps=11, batch_size=32, minibatch_size=32,
+            val_size=32, val_interval=4, correlation_interval=3,
+            show_progress=False, seed=5, prefetch=prefetch,
+            log_dir="/tmp/gym_tpu_test_logs")
+
+    off, on = fit(False), fit(True)
+    assert _losses(off) == _losses(on)
+    assert off.history["local_loss"] == on.history["local_loss"]
+    assert off.history["global_loss"] == on.history["global_loss"]
+    assert (off.history["avg_model_correlation"]
+            == on.history["avg_model_correlation"])
+
+
+# -- async checkpointing ---------------------------------------------------
+
+
+def test_save_async_does_not_block_caller(tmp_path):
+    """Acceptance: an in-flight save must not stall the caller. The Orbax
+    write is slowed to ~0.6 s; save_async must return in a fraction of
+    that, and wait() must make the write durable."""
+    from gym_tpu.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path), "async_test", async_save=True)
+    write_started = threading.Event()
+    orig_write = mgr._write
+
+    def slow_write(step, state, data_state, extra):
+        write_started.set()
+        time.sleep(0.6)
+        orig_write(step, state, data_state, extra)
+
+    mgr._write = slow_write
+    state = {"w": jax.device_put(np.arange(1024.0, dtype=np.float32))}
+    t0 = time.perf_counter()
+    mgr.save_async(1, state, {"epoch": 0, "pos": [0]})
+    enqueue_dt = time.perf_counter() - t0
+    assert enqueue_dt < 0.3, f"save_async blocked for {enqueue_dt:.2f}s"
+    assert write_started.wait(5.0)
+    # the caller keeps working while the write is in flight
+    assert mgr.latest_step() is None or mgr.latest_step() < 1
+    mgr.wait()
+    assert mgr.latest_step() == 1
+    step, restored, data_state, _ = mgr.restore(state)
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.arange(1024.0, dtype=np.float32))
+    assert data_state == {"epoch": 0, "pos": [0]}
+    mgr.close()
+
+
+def test_step_clock_advances_during_inflight_save(tmp_path, monkeypatch):
+    """Acceptance bullet 4, end to end: while an Orbax write is in flight
+    on the writer thread, the fit loop's step clock must KEEP ADVANCING.
+    The write is held open until it directly observes further
+    ``increment_step`` calls — an event, not a wall-clock race."""
+    import gym_tpu.trainer as trainer_mod
+    from gym_tpu.utils import checkpoint as ckpt_mod
+    from gym_tpu.utils.logger import CSVLogger
+
+    progress = {"steps": 0}
+
+    class CountingLogger(CSVLogger):
+        def increment_step(self):
+            super().increment_step()
+            progress["steps"] = self.step
+
+    monkeypatch.setattr(trainer_mod, "CSVLogger", CountingLogger)
+
+    advanced_during_save = threading.Event()
+    orig_write = ckpt_mod.CheckpointManager._write
+
+    def observing_write(self, step, state, data_state, extra):
+        if not advanced_during_save.is_set():
+            # hold the write open until the step clock moves (the final
+            # at-max_steps save has nothing left to advance — the event
+            # is already set by then)
+            at_enqueue = progress["steps"]
+            deadline = time.perf_counter() + 15.0
+            while time.perf_counter() < deadline:
+                if progress["steps"] > at_enqueue:
+                    advanced_during_save.set()
+                    break
+                time.sleep(0.01)
+        orig_write(self, step, state, data_state, extra)
+
+    monkeypatch.setattr(ckpt_mod.CheckpointManager, "_write",
+                        observing_write)
+    res = _fit(blobs(512), prefetch=True, max_steps=12, seed=2,
+               checkpoint_interval=3, save_dir=str(tmp_path),
+               run_name="clock_test")
+    assert res.steps == 12
+    assert advanced_during_save.is_set(), \
+        "dispatch loop stalled during the in-flight checkpoint write"
+    # and the written checkpoint is usable
+    from gym_tpu.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path), "clock_test")
+    assert mgr.latest_step() == 12
+    mgr.close()
+
+
+def test_writer_error_surfaces_on_wait(tmp_path):
+    from gym_tpu.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path), "err_test", async_save=True)
+
+    def bad_write(step, state, data_state, extra):
+        raise OSError("disk full")
+
+    mgr._write = bad_write
+    mgr.save_async(1, {"w": np.zeros(4, np.float32)}, {"pos": [0]})
+    with pytest.raises(RuntimeError, match="async checkpoint write failed"):
+        mgr.wait()
+    mgr.close()  # error already surfaced and cleared; shutdown is clean

@@ -13,6 +13,15 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
+try:  # jax >= 0.6 promoted shard_map out of experimental
+    shard_map = jax.shard_map
+except AttributeError:  # jax 0.4.x (whose check_rep chokes on scan carries)
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def shard_map(f, **kw):
+        kw.pop("check_vma", None)  # the new-API spelling of check_rep
+        return _shard_map_legacy(f, check_rep=False, **kw)
+
 from gym_tpu import Trainer
 from gym_tpu.data import ArrayDataset
 from gym_tpu.models.nanogpt import GPT, GPTConfig
@@ -30,7 +39,7 @@ def _shard_ring(q, k, v, n, devices):
         return ring_causal_attention(q, k, v, axis_name="seq")
 
     return jax.jit(
-        jax.shard_map(f, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)
+        shard_map(f, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)
     )(q, k, v)
 
 
@@ -82,7 +91,7 @@ def test_ring_dropout_semantics(devices8):
         )
 
     out = jax.jit(
-        jax.shard_map(f, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)
+        shard_map(f, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)
     )(q, k, v)
     ref = _shard_ring(q, k, v, 4, jax.devices())
     assert np.all(np.isfinite(np.asarray(out)))
@@ -190,7 +199,7 @@ def test_ring_kernel_blocks_match_dense(devices8, n):
                 return ring_causal_attention(q, k, v, axis_name="seq")
             # check_vma=False: pallas_call out_shapes carry no vma info
             # (the NodeRuntime programs run with the same setting)
-            out = jax.shard_map(f, mesh=mesh, in_specs=(spec,) * 3,
+            out = shard_map(f, mesh=mesh, in_specs=(spec,) * 3,
                                 out_specs=spec, check_vma=False)(q, k, v)
             return (out.astype(jnp.float32) ** 2).mean(), out
 
@@ -241,7 +250,7 @@ def test_ring_zigzag_matches_dense(devices8, n):
                                      layout="zigzag")
 
     with jax.default_matmul_precision("highest"):
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(shard_map(
             f, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec
         ))(q[..., perm, :], k[..., perm, :], v[..., perm, :])
         ref = dense_causal_attention(q, k, v)
@@ -268,7 +277,7 @@ def test_ring_zigzag_dropout_finite(devices8):
                 q, k, v, axis_name="seq", layout="zigzag",
                 dropout_rate=0.5, dropout_rng=jax.random.PRNGKey(0),
                 deterministic=det)
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             g, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec
         ))(q[..., perm, :], k[..., perm, :], v[..., perm, :])
 
@@ -301,7 +310,7 @@ def test_ring_zigzag_kernel_blocks_match_dense(devices8, n):
             def f(q, k, v):
                 return ring_causal_attention(q, k, v, axis_name="seq",
                                              layout="zigzag")
-            out = jax.shard_map(f, mesh=mesh, in_specs=(spec,) * 3,
+            out = shard_map(f, mesh=mesh, in_specs=(spec,) * 3,
                                 out_specs=spec, check_vma=False)(
                 q[..., perm, :], k[..., perm, :], v[..., perm, :])
             out = out[..., inv, :]
