@@ -107,7 +107,8 @@ def _supervise() -> int:
             return 0
     env = dict(os.environ)
     env["_GYM_TPU_BENCH_CHILD"] = "1"
-    if "--overlap-only" in sys.argv and force_cpu:
+    if ("--overlap-only" in sys.argv or "--resilience-only" in sys.argv) \
+            and force_cpu:
         # ablation-only CPU run: same 16-virtual-device layout the test
         # harness and _overlap_subprocess use (pre-init flag)
         env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=16 "
@@ -145,6 +146,33 @@ VOCAB = 65          # shakespeare char vocab (reference build_dataset.py:8-21)
 BATCH_PER_NODE = 16
 WARMUP = int(os.environ.get("GYM_TPU_BENCH_WARMUP", 3))
 TIMED = int(os.environ.get("GYM_TPU_BENCH_STEPS", 20))
+
+
+def _interleaved_ab(run, steps: int, windows: int):
+    """Median-of-windows A/B with arm order ALTERNATED window to window:
+    shared-machine throughput drifts by more than the effect size, so a
+    fixed A-then-B order would systematically bias whichever arm runs
+    later in each pair, and a max-statistic just samples the drift.
+    ``run(arm: bool, steps)`` returns a FitResult; the steady-state rate
+    is compared (falls back to the full-run rate for 1-dispatch runs).
+    Returns ``(off_median_its, on_median_its, losses_bit_identical)``.
+    Shared by the host-overlap and resilience ablations so the two
+    measurement protocols cannot drift apart."""
+    offs, ons = [], []
+    losses_off = losses_on = None
+    for w in range(windows):
+        order = (False, True) if w % 2 == 0 else (True, False)
+        for arm in order:
+            res = run(arm, steps)
+            its = res.steps_per_second_steady or res.steps_per_second
+            (ons if arm else offs).append(its)
+            losses = [l for _, l in res.history["train_loss"]]
+            if arm:
+                losses_on = losses
+            else:
+                losses_off = losses
+    return (sorted(offs)[len(offs) // 2], sorted(ons)[len(ons) // 2],
+            losses_off == losses_on)
 
 
 def measure_host_overlap() -> dict:
@@ -220,7 +248,7 @@ def measure_host_overlap() -> dict:
     def run(overlap: bool, max_steps: int, ckpt: bool = True):
         save_dir = tempfile.mkdtemp(prefix="gym_tpu_overlap_ckpt_")
         try:
-            return Trainer(MLP(), ds).fit(
+            res = Trainer(MLP(), ds).fit(
                 strategy=DiLoCoStrategy(
                     optim_spec=OptimSpec("adamw", lr=1e-3), H=100),
                 num_nodes=nodes, max_steps=max_steps, batch_size=64,
@@ -231,33 +259,20 @@ def measure_host_overlap() -> dict:
                 save_dir=save_dir if ckpt else None,
                 log_dir=os.environ.get("GYM_TPU_BENCH_LOGDIR",
                                        "/tmp/gym_tpu_bench_logs"))
+            if res.preempted:
+                # Ctrl-C now returns a normal-looking partial FitResult;
+                # a truncated sample must abort the A/B, not pollute it
+                raise KeyboardInterrupt("fit preempted mid-benchmark")
+            return res
         finally:
             # fresh dir per run: a leftover checkpoint would RESUME the
             # next fit instead of starting it from scratch
             shutil.rmtree(save_dir, ignore_errors=True)
 
     run(False, 2 * spc, ckpt=False)  # primes the persistent compile cache
-    # median of N windows per arm, arm order ALTERNATED window to window:
-    # shared-machine throughput drifts by more than the effect size, so a
-    # fixed A-then-B order would systematically bias whichever arm runs
-    # later in each pair, and a max-statistic just samples the drift
     windows = max(1, int(os.environ.get("GYM_TPU_BENCH_OVERLAP_WINDOWS",
                                         5)))
-    offs, ons = [], []
-    losses_off = losses_on = None
-    for w in range(windows):
-        order = (False, True) if w % 2 == 0 else (True, False)
-        for arm in order:
-            res = run(arm, steps)
-            its = res.steps_per_second_steady or res.steps_per_second
-            (ons if arm else offs).append(its)
-            losses = [l for _, l in res.history["train_loss"]]
-            if arm:
-                losses_on = losses
-            else:
-                losses_off = losses
-    off_its = sorted(offs)[len(offs) // 2]
-    on_its = sorted(ons)[len(ons) // 2]
+    off_its, on_its, bit_identical = _interleaved_ab(run, steps, windows)
     return {
         "metric": "host_overlap_ablation_steps_per_sec",
         "workload": (f"mlp(1024-{hid}-10) map-style dataset, diloco {nodes}n "
@@ -267,7 +282,88 @@ def measure_host_overlap() -> dict:
         "overlap_off_it_s": round(off_its, 3),
         "overlap_on_it_s": round(on_its, 3),
         "speedup": round(on_its / off_its, 3) if off_its else None,
-        "loss_bit_identical": losses_off == losses_on,
+        "loss_bit_identical": bit_identical,
+    }
+
+
+def measure_resilience_overhead() -> dict:
+    """A/B the ISSUE 2 resilience layer's steady-state cost: the SAME
+    seeded fit with the watchdog armed (deadline contexts around every
+    drain/prefetch-get/checkpoint region) vs off. The fault-injection
+    registry (empty: one attribute read per site) and the retry wrappers
+    (no-op on the success path) are active in BOTH arms — they are
+    always-on in production too; the watchdog thread + context managers
+    are the only toggleable cost. Expected: noise.
+    """
+    import shutil
+    import tempfile
+
+    import flax.linen as nn
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from gym_tpu import Trainer
+    from gym_tpu.data import ArrayDataset
+    from gym_tpu.strategy.diloco import DiLoCoStrategy
+    from gym_tpu.strategy.optim import OptimSpec
+    from gym_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache(
+        os.environ.get("GYM_TPU_BENCH_CACHE_DIR"), min_compile_time_secs=0)
+
+    steps = int(os.environ.get("GYM_TPU_BENCH_RESIL_STEPS", 192))
+    spc = int(os.environ.get("GYM_TPU_BENCH_RESIL_SPC", 8))
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, batch, train=True):
+            x, y = batch
+            x = x.reshape((x.shape[0], -1))
+            h = nn.relu(nn.Dense(256)(x))
+            logits = nn.Dense(10)(h)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), y).mean()
+
+    rng = np.random.default_rng(0)
+    ds = ArrayDataset(
+        rng.normal(0, 1, size=(8192, 32, 32)).astype(np.float32),
+        rng.integers(0, 10, 8192).astype(np.int32))
+
+    def run(watchdog: bool, max_steps: int):
+        save_dir = tempfile.mkdtemp(prefix="gym_tpu_resil_ckpt_")
+        try:
+            res = Trainer(MLP(), ds).fit(
+                strategy=DiLoCoStrategy(
+                    optim_spec=OptimSpec("adamw", lr=1e-3), H=100),
+                num_nodes=8, max_steps=max_steps, batch_size=64,
+                minibatch_size=64, steps_per_call=spc, val_size=0,
+                val_interval=0, show_progress=False, seed=7,
+                checkpoint_interval=24, save_dir=save_dir,
+                # 0.0, not None: None falls back to GYM_TPU_WATCHDOG_S,
+                # which would arm the watchdog in the OFF arm too
+                watchdog_timeout=300.0 if watchdog else 0.0,
+                log_dir=os.environ.get("GYM_TPU_BENCH_LOGDIR",
+                                       "/tmp/gym_tpu_bench_logs"))
+            if res.preempted:
+                raise KeyboardInterrupt("fit preempted mid-benchmark")
+            return res
+        finally:
+            shutil.rmtree(save_dir, ignore_errors=True)
+
+    run(False, 2 * spc)  # primes the persistent compile cache
+    windows = max(1, int(os.environ.get("GYM_TPU_BENCH_RESIL_WINDOWS", 5)))
+    off_its, on_its, bit_identical = _interleaved_ab(run, steps, windows)
+    return {
+        "metric": "resilience_overhead_steps_per_sec",
+        "workload": (f"mlp(1024-256-10), diloco 8n bs64 spc{spc} "
+                     f"x{steps} steps, ckpt every 24"),
+        "timing": f"median_of_{windows}_interleaved",
+        "watchdog_off_it_s": round(off_its, 3),
+        "watchdog_on_it_s": round(on_its, 3),
+        "overhead_pct": round(100.0 * (off_its - on_its) / off_its, 2)
+        if off_its else None,
+        "loss_bit_identical": bit_identical,
     }
 
 
@@ -316,6 +412,11 @@ def main() -> None:
 
     if "--overlap-only" in sys.argv:
         print(json.dumps({"host_overlap": measure_host_overlap()}))
+        return
+
+    if "--resilience-only" in sys.argv:
+        print(json.dumps(
+            {"resilience_overhead": measure_resilience_overhead()}))
         return
 
     import numpy as np

@@ -32,6 +32,8 @@ from typing import Callable, List, Optional, Sequence
 import jax
 import numpy as np
 
+from ..utils.resilience import fault_point
+
 _SENTINEL_ERROR = "__prefetch_error__"
 _SENTINEL_DONE = "__prefetch_done__"
 
@@ -168,6 +170,7 @@ class HostPrefetcher:
             for s in self._schedule:
                 if self._stop.is_set():
                     return
+                fault_point("prefetch.fill")
                 host_batch = self._assemble(s)
                 state = copy.deepcopy(self._iter.state())
                 device_batch = self._feed(host_batch)

@@ -1,5 +1,10 @@
+from .checkpoint import CheckpointNotFoundError
 from .compile_cache import enable_compilation_cache
 from .logger import CSVLogger, Logger, WandbLogger
+from .resilience import (FAULT_SITES, RetryPolicy, Watchdog, fault_point,
+                         faults, with_retries)
 
 __all__ = ["CSVLogger", "Logger", "WandbLogger",
+           "CheckpointNotFoundError", "FAULT_SITES", "RetryPolicy",
+           "Watchdog", "fault_point", "faults", "with_retries",
            "enable_compilation_cache"]

@@ -27,18 +27,39 @@ Saves come in two flavors:
   critical path. If a newer save arrives while one is still being
   written, the older PENDING save is coalesced away (the in-flight write
   completes) — checkpoints are recovery points, the newest wins.
+
+Resilience (ISSUE 2): every write — sync, async, and the writer thread's
+``device_get`` — runs under a ``RetryPolicy`` (exponential backoff +
+jitter), so one transient ``OSError`` no longer poisons the run through
+the writer-thread error latch; ``restore`` walks checkpoints NEWEST-FIRST
+and skips past corrupt/torn step dirs (the reference's corrupt-zipfile
+skip, ``exogym/train_node.py``), quarantining each aside as
+``<step>.corrupt-k`` — never deleting, since a skip may also be a
+template mismatch or an IO error that outlived its retries — so a later
+save of the same step doesn't collide with Orbax's cached step list; and
+a missing checkpoint raises the typed ``CheckpointNotFoundError``
+instead of an ``assert``.
 """
 
 from __future__ import annotations
 
 import os
+import shutil
 import threading
 from typing import Any, Optional, Tuple
 
 import jax
 import numpy as np
 
+from .resilience import (RetryPolicy, Watchdog, dump_thread_stacks,
+                         fault_point, watch_or_null, with_retries)
+
 PyTree = Any
+
+
+class CheckpointNotFoundError(RuntimeError):
+    """No (valid) checkpoint exists to restore — either the run directory
+    has no committed steps, or every committed step is corrupt."""
 
 
 class CheckpointManager:
@@ -49,31 +70,34 @@ class CheckpointManager:
     (all simulated nodes live in one sharded state).
     """
 
-    def __init__(self, save_dir: str, run_name: str, max_to_keep: int = 1,
-                 async_save: bool = True):
+    def __init__(self, save_dir: str, run_name: str, max_to_keep: int = 2,
+                 async_save: bool = True,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 watchdog: Optional[Watchdog] = None,
+                 close_timeout: float = 600.0):
         """``async_save=True`` enables the ``save_async`` writer thread;
         ``False`` forces every save synchronous — required in a
         multi-process world, where a background write on one process
         would race the collective write protocol; the Trainer passes it
-        automatically."""
+        automatically.
+
+        ``max_to_keep`` defaults to 2, not 1: restore falls back past a
+        corrupt newest checkpoint, which only helps if an older valid
+        one survives pruning. ``retry_policy`` governs transient-IO
+        retries (default: ``RetryPolicy.from_env()``); ``watchdog``, when
+        set, deadline-protects the blocking write/wait regions."""
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
         path = os.path.abspath(os.path.join(save_dir, run_name))
         os.makedirs(path, exist_ok=True)
         self.directory = path
-        self.manager = ocp.CheckpointManager(
-            path,
-            options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep,
-                # Orbax's own async path still blocks the caller on the
-                # device→host copy; our writer thread moves that off the
-                # critical path too, so the underlying writes stay sync.
-                enable_async_checkpointing=False,
-                create=True,
-            ),
-        )
+        self._max_to_keep = max_to_keep
+        self.manager = self._make_manager()
         self._async = async_save
+        self._retry = retry_policy or RetryPolicy.from_env()
+        self._watchdog = watchdog
+        self._close_timeout = close_timeout
         self._writer: Optional[threading.Thread] = None
         self._work = threading.Condition()
         self._pending: Optional[tuple] = None
@@ -81,19 +105,40 @@ class CheckpointManager:
         self._closing = False
         self._writer_error: Optional[BaseException] = None
 
+    def _make_manager(self):
+        ocp = self._ocp
+        return ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=self._max_to_keep,
+                # Orbax's own async path still blocks the caller on the
+                # device→host copy; our writer thread moves that off the
+                # critical path too, so the underlying writes stay sync.
+                enable_async_checkpointing=False,
+                create=True,
+            ),
+        )
+
     # -- writes -----------------------------------------------------------
 
     def _write(self, step: int, state: PyTree, data_state: dict,
                extra: Optional[dict]) -> None:
         ocp = self._ocp
         meta = {"data_state": data_state, "extra": extra or {}}
-        self.manager.save(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardSave(state),
-                meta=ocp.args.JsonSave(meta),
-            ),
-        )
+
+        def attempt():
+            fault_point("checkpoint.write")
+            self.manager.save(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave(state),
+                    meta=ocp.args.JsonSave(meta),
+                ),
+            )
+
+        with watch_or_null(self._watchdog, f"checkpoint.write step {step}"):
+            with_retries(attempt, self._retry,
+                         describe=f"checkpoint write (step {step})")
 
     def save(self, step: int, state: PyTree, data_state: dict,
              extra: Optional[dict] = None) -> None:
@@ -141,7 +186,16 @@ class CheckpointManager:
                 self._inflight = True
             try:
                 step, snapshot, data_state, extra = item
-                host_state = jax.device_get(snapshot)
+
+                def fetch(snapshot=snapshot):
+                    fault_point("checkpoint.device_get")
+                    return jax.device_get(snapshot)
+
+                with watch_or_null(self._watchdog,
+                                   f"checkpoint.device_get step {step}"):
+                    host_state = with_retries(
+                        fetch, self._retry,
+                        describe=f"checkpoint device_get (step {step})")
                 del snapshot  # release the device-side copy promptly
                 self._write(step, host_state, data_state, extra)
             except BaseException as e:  # noqa: BLE001 — surfaced on wait()
@@ -161,17 +215,9 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self.manager.latest_step()
 
-    def restore(self, template_state: PyTree,
-                step: Optional[int] = None) -> Tuple[int, PyTree, dict, dict]:
-        """Restore ``(step, state, data_state, extra)``.
-
-        ``template_state`` supplies shapes/dtypes/shardings (the freshly
-        initialized state) so arrays are restored directly onto the mesh.
-        """
+    def _restore_step(self, step: int, template_state: PyTree
+                      ) -> Tuple[int, PyTree, dict, dict]:
         ocp = self._ocp
-        if step is None:
-            step = self.manager.latest_step()
-        assert step is not None, "no checkpoint to restore"
         restored = self.manager.restore(
             step,
             args=ocp.args.Composite(
@@ -184,20 +230,125 @@ class CheckpointManager:
             meta.get("extra", {})
         )
 
+    def restore(self, template_state: PyTree,
+                step: Optional[int] = None) -> Tuple[int, PyTree, dict, dict]:
+        """Restore ``(step, state, data_state, extra)``.
+
+        ``template_state`` supplies shapes/dtypes/shardings (the freshly
+        initialized state) so arrays are restored directly onto the mesh.
+
+        With ``step=None``, walks committed steps NEWEST-FIRST and falls
+        back past corrupt/torn step dirs (a ``kill -9`` mid-write, a
+        zeroed array file): each skipped dir is logged, QUARANTINED
+        (renamed aside, never deleted — a skip may also be a template
+        mismatch or an IO error that outlived its retries), and the
+        Orbax manager reloaded — its cached step list would otherwise
+        silently skip a later re-save of the same step number. Raises
+        ``CheckpointNotFoundError`` when no step, or no VALID step,
+        exists. With an explicit ``step``, a missing step raises
+        ``CheckpointNotFoundError``; a corrupt one propagates the
+        underlying error (the caller asked for that exact state).
+        """
+        if step is not None:
+            if step not in self.manager.all_steps():
+                raise CheckpointNotFoundError(
+                    f"checkpoint step {step} not found under "
+                    f"{self.directory} (have {self.manager.all_steps()})")
+            return with_retries(
+                lambda: self._restore_step(step, template_state),
+                self._retry, describe=f"checkpoint restore (step {step})")
+        steps = sorted(self.manager.all_steps(), reverse=True)
+        if not steps:
+            raise CheckpointNotFoundError(
+                f"no checkpoint to restore under {self.directory}")
+        skipped = []
+        out = None
+        for s in steps:
+            try:
+                # transient IO errors are retried BEFORE a step is
+                # classified corrupt — the fallback below deletes what it
+                # skips, and a one-shot flaky read must not destroy a
+                # valid newest checkpoint
+                out = with_retries(
+                    lambda s=s: self._restore_step(s, template_state),
+                    self._retry, describe=f"checkpoint restore (step {s})")
+                break
+            except Exception as e:  # noqa: BLE001 — corrupt-dir fallback
+                skipped.append((s, e))
+        if skipped:
+            import sys
+            for s, e in skipped:
+                sys.stderr.write(
+                    f"gym_tpu: skipping unreadable checkpoint step {s} "
+                    f"under {self.directory} ({type(e).__name__}: {e}); "
+                    f"quarantining it and falling back to an older step\n")
+                self._quarantine_step(s)
+            # Orbax caches the step list at manager construction and
+            # SILENTLY skips saves of steps it believes exist — reload so
+            # the run (resumed OR restarted fresh after an all-corrupt
+            # fallthrough) can re-save the deleted step numbers.
+            self.manager.close()
+            self.manager = self._make_manager()
+        if out is None:
+            raise CheckpointNotFoundError(
+                f"no valid checkpoint under {self.directory}: every step "
+                f"in {steps} failed to restore "
+                f"(newest: {type(skipped[0][1]).__name__}: {skipped[0][1]})"
+            ) from skipped[0][1]
+        return out
+
+    def _quarantine_step(self, step: int) -> None:
+        """Move an unreadable step dir aside (``<step>.corrupt-k``) rather
+        than deleting it: the restore fallback cannot reliably tell true
+        corruption from, say, a template shape mismatch, so what it skips
+        must stay recoverable by hand. Orbax ignores non-numeric dirs, so
+        the quarantined copy no longer blocks a re-save of the step."""
+        src = os.path.join(self.directory, str(step))
+        for k in range(100):
+            dst = f"{src}.corrupt-{k}"
+            if not os.path.exists(dst):
+                try:
+                    os.rename(src, dst)
+                    return
+                except OSError:
+                    break
+        shutil.rmtree(src, ignore_errors=True)  # last resort: unblock
+
+    def purge(self) -> None:
+        """Delete every committed step and reload the Orbax manager —
+        ``fit(resume="never")``'s start-over semantics. The reload
+        matters: Orbax caches the step list at construction and silently
+        skips saves of step numbers it believes already exist."""
+        self.wait()
+        for s in list(self.manager.all_steps()):
+            shutil.rmtree(os.path.join(self.directory, str(s)),
+                          ignore_errors=True)
+        self.manager.close()
+        self.manager = self._make_manager()
+
     def wait(self) -> None:
         """Block until every enqueued save is durable."""
-        with self._work:
-            while self._pending is not None or self._inflight:
-                self._work.wait()
-            self._raise_writer_error()
-        self.manager.wait_until_finished()
+        with watch_or_null(self._watchdog, "checkpoint.wait"):
+            with self._work:
+                while self._pending is not None or self._inflight:
+                    self._work.wait()
+                self._raise_writer_error()
+            self.manager.wait_until_finished()
 
     def close(self) -> None:
         with self._work:
             self._closing = True
             self._work.notify_all()
         if self._writer is not None:
-            self._writer.join(timeout=600.0)
+            self._writer.join(timeout=self._close_timeout)
+            if self._writer.is_alive():
+                # A silently leaked writer thread means a write is hung
+                # (filesystem stall, injected hang) — fail loudly with
+                # the evidence rather than pretend the close succeeded.
+                raise RuntimeError(
+                    f"checkpoint writer thread still alive after "
+                    f"{self._close_timeout:.0f}s close timeout — a write "
+                    f"is hung\n" + dump_thread_stacks("thread stacks:"))
         with self._work:
             self._raise_writer_error()
         self.manager.wait_until_finished()

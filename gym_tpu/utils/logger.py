@@ -38,7 +38,12 @@ class Logger:
         )
 
     def log_train(self, loss: float, lr: float = 0.0,
-                  comm_bytes: float = 0.0) -> None:
+                  comm_bytes: float = 0.0,
+                  step: Optional[int] = None) -> None:
+        """``step`` pins the record to the step the loss was COMPUTED at
+        (the fit loop drains metrics one dispatch late for host overlap,
+        so ``self.step`` has already moved on). Required for crash+resume
+        CSV stitching: rows are pruned/re-logged by true step."""
         self.cum_comm_bytes += comm_bytes
         if self.pbar is not None:
             self.pbar.set_postfix(
@@ -79,6 +84,11 @@ class Logger:
             if mfu is not None:
                 self.pbar.write(f"MFU {mfu:.1%}")
 
+    def sync(self) -> None:
+        """Make everything logged so far durable (fsync where backed by
+        files). The Trainer calls this at every checkpoint boundary so a
+        crash after a checkpoint loses no rows the checkpoint covers."""
+
     def close(self) -> None:
         if self.pbar is not None:
             self.pbar.close()
@@ -115,11 +125,26 @@ def _fmt_bytes(n: float) -> str:
 
 class CSVLogger(Logger):
     """``logs/<run>/{train.csv,validation.csv,config.json}``
-    (reference ``logger.py:134-201``)."""
+    (reference ``logger.py:134-201``).
+
+    Resume semantics (ISSUE 2 — these files used to be opened ``"w"``,
+    so a resumed run erased all prior history): with ``resume_step > 0``
+    every row logged BEFORE the restored step is preserved and rows at
+    or past it are dropped — the resumed run re-logs them, so after a
+    crash+resume the files read exactly as an uninterrupted run's. Rows
+    are filtered, not blindly appended, because a ``kill -9`` can leave
+    a torn final line and rows past the restore point would duplicate.
+    ``sync()`` fsyncs both streams; the Trainer calls it at every
+    checkpoint boundary, making every row a checkpoint covers durable.
+    """
+
+    _TRAIN_HEADER = ["step", "loss", "lr", "comm_bytes", "cum_comm_bytes"]
+    _VAL_HEADER = ["step", "name", "loss", "perplexity"]
 
     def __init__(self, max_steps: int, run_name: Optional[str] = None,
                  log_dir: str = "logs", config: Optional[Dict] = None,
-                 show_progress: bool = True):
+                 show_progress: bool = True, resume_step: int = 0,
+                 resume_cum_comm: Optional[float] = None):
         super().__init__(max_steps, show_progress)
         run_name = run_name or f"run_{int(time.time())}"
         self.run_dir = os.path.join(log_dir, run_name)
@@ -127,20 +152,65 @@ class CSVLogger(Logger):
         if config is not None:
             with open(os.path.join(self.run_dir, "config.json"), "w") as f:
                 json.dump(_jsonable(config), f, indent=2, default=str)
-        self._train_f = open(os.path.join(self.run_dir, "train.csv"), "w",
-                             newline="")
-        self._train_w = csv.writer(self._train_f)
-        self._train_w.writerow(["step", "loss", "lr", "comm_bytes",
-                                "cum_comm_bytes"])
-        self._val_f = open(os.path.join(self.run_dir, "validation.csv"), "w",
-                           newline="")
-        self._val_w = csv.writer(self._val_f)
-        self._val_w.writerow(["step", "name", "loss", "perplexity"])
+        self._train_f, self._train_w, train_kept = self._open_csv(
+            "train.csv", self._TRAIN_HEADER, resume_step)
+        self._val_f, self._val_w, _ = self._open_csv(
+            "validation.csv", self._VAL_HEADER, resume_step)
+        # Comm accumulation continues across the resume so the cum column
+        # stays continuous (and bit-identical to an uninterrupted run).
+        # ``resume_cum_comm`` is the EXACT accumulator saved in the
+        # checkpoint's extra metadata (the Trainer passes it through);
+        # the last kept CSV row is the fallback, %.0f-rounded, so with
+        # fractional per-step comm it can drift where the extra cannot.
+        if resume_cum_comm is not None:
+            self.cum_comm_bytes = float(resume_cum_comm)
+        elif train_kept:
+            try:
+                self.cum_comm_bytes = float(train_kept[-1][4])
+            except (ValueError, IndexError):
+                pass
 
-    def log_train(self, loss, lr=0.0, comm_bytes=0.0):
-        super().log_train(loss, lr, comm_bytes)
+    def _open_csv(self, name: str, header, resume_step: int):
+        """(Re)open a CSV stream, keeping pre-restore rows on resume.
+
+        A kept row must have the full column count (a torn line from a
+        mid-write crash is a strict prefix, so it has fewer fields or an
+        intact step field that the ``< resume_step`` filter drops) and a
+        step strictly before the restored step.
+
+        The filtered file is rewritten ATOMICALLY (temp + fsync +
+        ``os.replace``) and then opened for append: truncating the
+        original in place would leave a window where a kill -9 during
+        resume initialization destroys the entire prior history — the
+        exact event this layer defends against."""
+        path = os.path.join(self.run_dir, name)
+        kept = []
+        if resume_step > 0 and os.path.exists(path):
+            with open(path, newline="") as f:
+                rows = list(csv.reader(f))
+            for r in rows[1:]:
+                try:
+                    if len(r) == len(header) and int(r[0]) < resume_step:
+                        kept.append(r)
+                except ValueError:
+                    continue  # unparseable (torn) row
+        tmp = path + ".tmp"
+        with open(tmp, "w", newline="") as tf:
+            tw = csv.writer(tf)
+            tw.writerow(header)
+            tw.writerows(kept)
+            tf.flush()
+            os.fsync(tf.fileno())
+        os.replace(tmp, path)
+        f = open(path, "a", newline="")
+        w = csv.writer(f)
+        return f, w, kept
+
+    def log_train(self, loss, lr=0.0, comm_bytes=0.0, step=None):
+        super().log_train(loss, lr, comm_bytes, step)
         self._train_w.writerow(
-            [self.step, f"{loss:.6f}", f"{lr:.8f}", f"{comm_bytes:.0f}",
+            [self.step if step is None else step, f"{loss:.6f}",
+             f"{lr:.8f}", f"{comm_bytes:.0f}",
              f"{self.cum_comm_bytes:.0f}"]
         )
 
@@ -156,6 +226,11 @@ class CSVLogger(Logger):
         super().log_summary(summary)
         with open(os.path.join(self.run_dir, "summary.json"), "w") as f:
             json.dump(_jsonable(summary), f, indent=2, default=str)
+
+    def sync(self):
+        for f in (self._train_f, self._val_f):
+            f.flush()
+            os.fsync(f.fileno())
 
     def close(self):
         super().close()
@@ -188,15 +263,15 @@ class WandbLogger(Logger):
             self._wandb = None
             self._run = None
 
-    def log_train(self, loss, lr=0.0, comm_bytes=0.0):
-        super().log_train(loss, lr, comm_bytes)
+    def log_train(self, loss, lr=0.0, comm_bytes=0.0, step=None):
+        super().log_train(loss, lr, comm_bytes, step)
         if self._run is not None:
             self._run.log(
                 {"train/loss": loss,
                  "train/perplexity": math.exp(min(loss, 20.0)),
                  "lr": lr, "comm/bytes_step": comm_bytes,
                  "comm/bytes_cum": self.cum_comm_bytes},
-                step=self.step,
+                step=self.step if step is None else step,
             )
 
     def log_loss(self, loss, name, step=None):

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Fault-tolerance gate (ISSUE 2) — the kill harness + in-process
+# resilience suite, run NEXT TO scripts/ci_tier1.sh (which excludes the
+# slow-marked kill sites). Subprocess `kill -9` at every registered
+# fault-injection site, resume, assert the stitched loss trajectory is
+# bit-identical to an uninterrupted run; plus the SIGTERM preemption
+# drill and the corrupt-checkpoint fallback. CPU-only, sized for the
+# 2-core container (the kill harness itself runs in ~45 s; the timeout
+# leaves headroom for the in-process suite and a loaded machine).
+#
+# Usage: scripts/ci_faults.sh   (from the repo root or anywhere)
+set -o pipefail
+cd "$(dirname "$0")/.."
+rm -f /tmp/_faults.log
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_kill_harness.py tests/test_resilience.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_faults.log
+rc=${PIPESTATUS[0]}
+echo FAULT_DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' \
+    /tmp/_faults.log | tr -cd . | wc -c)
+exit $rc

@@ -1,0 +1,82 @@
+"""Kill-harness worker: one ``Trainer.fit`` of a tiny deterministic
+workload, run as a subprocess so the harness can ``kill -9`` it at an
+injected fault site (armed via ``GYM_TPU_FAULTS`` in the environment)
+or SIGTERM it for the preemption drill, then relaunch it to resume.
+
+The parent controls everything through env + argv; on a clean finish
+the worker writes a JSON result (steps reached, preempted flag, loss
+trajectory) so the harness can assert against it. The workload is the
+same TinyLossModel/blobs pair the in-process tests use, duplicated here
+because the worker must be importable without pytest on sys.path.
+"""
+
+import argparse
+import json
+import os
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--save-dir", required=True)
+    ap.add_argument("--log-dir", required=True)
+    ap.add_argument("--max-steps", type=int, default=12)
+    ap.add_argument("--ckpt-interval", type=int, default=3)
+    ap.add_argument("--sync-ckpt", action="store_true",
+                    help="synchronous checkpoint saves: commits happen "
+                         "at the dispatch boundary, so a kill at boundary "
+                         "N deterministically finds earlier saves durable")
+    ap.add_argument("--no-prefetch", action="store_true")
+    ap.add_argument("--result", default="")
+    args = ap.parse_args()
+
+    import flax.linen as nn
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from gym_tpu import Trainer
+    from gym_tpu.data import ArrayDataset
+    from gym_tpu.strategy import OptimSpec, SimpleReduceStrategy
+    from gym_tpu.utils.compile_cache import enable_compilation_cache
+
+    cache = os.environ.get("GYM_TPU_TEST_COMPILE_CACHE")
+    if cache:
+        # every relaunch of this worker recompiles the same tiny program;
+        # the persistent cache keeps the whole harness inside its budget
+        enable_compilation_cache(cache, min_compile_time_secs=0)
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, batch, train=True):
+            x, y = batch
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(32)(x))
+            return optax.softmax_cross_entropy_with_integer_labels(
+                nn.Dense(10)(x).astype(jnp.float32), y).mean()
+
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 10, size=256).astype(np.int32)
+    x = rng.normal(0, 0.3, size=(256, 8, 8)).astype(np.float32)
+    for i, y in enumerate(labels):
+        x[i, y % 8, :] += 1.5
+
+    res = Trainer(Tiny(), ArrayDataset(x, labels)).fit(
+        strategy=SimpleReduceStrategy(OptimSpec("sgd", lr=0.05)),
+        num_nodes=2, max_steps=args.max_steps, batch_size=16,
+        minibatch_size=8, val_interval=0, show_progress=False, seed=3,
+        checkpoint_interval=args.ckpt_interval, save_dir=args.save_dir,
+        run_name="kill", log_dir=args.log_dir,
+        async_checkpoint=not args.sync_ckpt,
+        prefetch=not args.no_prefetch,
+    )
+    if args.result:
+        with open(args.result, "w") as f:
+            json.dump({
+                "steps": res.steps,
+                "preempted": res.preempted,
+                "losses": [[s, l] for s, l in res.history["train_loss"]],
+            }, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,512 @@
+"""Host-resilience layer (ISSUE 2), in-process surface.
+
+Covers the fault-injection registry's deterministic hit windows, the
+retry policy, the watchdog, the hardened CheckpointManager (typed
+errors, transient-failure retry in the async writer, newest-wins
+coalescing under slow/failing in-flight writes, corrupt-newest restore
+fallback, loud close), CSVLogger crash/resume semantics, and the
+Trainer-level resume knob. The subprocess kill -9 / SIGTERM drills live
+in ``tests/test_kill_harness.py``.
+"""
+
+import os
+import shutil
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from gym_tpu import Trainer
+from gym_tpu.strategy import OptimSpec, SimpleReduceStrategy
+from gym_tpu.utils.checkpoint import (CheckpointManager,
+                                      CheckpointNotFoundError)
+from gym_tpu.utils.logger import CSVLogger
+from gym_tpu.utils.resilience import (FaultRegistry, InjectedFault,
+                                      RetryPolicy, Watchdog, fault_point,
+                                      faults, with_retries)
+
+from test_trainer_e2e import TinyLossModel, blobs
+
+FAST_RETRY = RetryPolicy(attempts=4, base_delay=0.01, max_delay=0.02)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# -- fault registry -------------------------------------------------------
+
+
+def test_fault_hit_windows():
+    faults.install("checkpoint.write", "oserror", first=2, last=3)
+    fault_point("checkpoint.write")  # hit 1: outside window
+    with pytest.raises(InjectedFault):
+        fault_point("checkpoint.write")  # hit 2
+    with pytest.raises(InjectedFault):
+        fault_point("checkpoint.write")  # hit 3
+    fault_point("checkpoint.write")  # hit 4: past window
+    assert faults.hits("checkpoint.write") == 4
+    faults.reset()
+    fault_point("checkpoint.write")  # no rules, no error
+    assert faults.hits("checkpoint.write") == 0  # reset also clears counts
+
+
+def test_fault_spec_parsing():
+    r = FaultRegistry()
+    r.configure("checkpoint.write:oserror@2, prefetch.fill:delay=0.5@3+ ,"
+                "dispatch.boundary:kill@5-7")
+    by_site = {rule.site: rule for rule in r._rules}
+    assert by_site["checkpoint.write"].action == "oserror"
+    assert (by_site["checkpoint.write"].first,
+            by_site["checkpoint.write"].last) == (2, 2)
+    assert by_site["prefetch.fill"].arg == 0.5
+    assert (by_site["prefetch.fill"].first,
+            by_site["prefetch.fill"].last) == (3, None)
+    assert (by_site["dispatch.boundary"].first,
+            by_site["dispatch.boundary"].last) == (5, 7)
+    with pytest.raises(ValueError, match="unknown fault site"):
+        r.configure("not.a.site:kill")
+    with pytest.raises(ValueError, match="unknown fault action"):
+        r.configure("checkpoint.write:explode")
+
+
+def test_default_window_is_every_hit():
+    faults.install("prefetch.fill", "oserror")
+    for _ in range(3):
+        with pytest.raises(InjectedFault):
+            fault_point("prefetch.fill")
+
+
+# -- retry policy ---------------------------------------------------------
+
+
+def test_with_retries_recovers_from_transient():
+    calls = {"n": 0}
+    retries = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise OSError(f"transient {calls['n']}")
+        return "ok"
+
+    out = with_retries(flaky, FAST_RETRY,
+                       on_retry=lambda k, e, d: retries.append((k, d)))
+    assert out == "ok" and calls["n"] == 3
+    assert [k for k, _ in retries] == [1, 2]
+    assert all(d >= 0 for _, d in retries)
+
+
+def test_with_retries_exhaustion_raises_last():
+    def always():
+        raise OSError("persistent")
+
+    with pytest.raises(OSError, match="persistent"):
+        with_retries(always, RetryPolicy(attempts=2, base_delay=0.01),
+                     on_retry=lambda *a: None)
+
+
+def test_with_retries_zero_attempts_still_calls_once():
+    # GYM_TPU_IO_RETRIES=0 must disable RETRYING, not skip the operation
+    calls = {"n": 0}
+
+    def op():
+        calls["n"] += 1
+        return "ran"
+
+    assert with_retries(op, RetryPolicy(attempts=0)) == "ran"
+    assert calls["n"] == 1
+
+
+def test_with_retries_nonretryable_propagates_immediately():
+    calls = {"n": 0}
+
+    def typed():
+        calls["n"] += 1
+        raise ValueError("not IO")
+
+    with pytest.raises(ValueError):
+        with_retries(typed, FAST_RETRY)
+    assert calls["n"] == 1
+
+
+def test_retry_delay_backoff_and_bounds():
+    p = RetryPolicy(attempts=8, base_delay=0.1, factor=2.0, max_delay=0.5,
+                    jitter=0.25)
+    for k in range(8):
+        d = p.delay(k)
+        assert 0.0 <= d <= 0.5 * 1.25
+    # un-jittered growth is exponential then capped
+    p0 = RetryPolicy(base_delay=0.1, factor=2.0, max_delay=0.5, jitter=0.0)
+    assert [p0.delay(k) for k in range(4)] == [0.1, 0.2, 0.4, 0.5]
+
+
+# -- watchdog -------------------------------------------------------------
+
+
+def test_watchdog_fires_on_hung_region_with_stacks():
+    fired = []
+    wd = Watchdog(0.2, on_timeout=lambda label, msg: fired.append(
+        (label, msg)), poll=0.05).start()
+    try:
+        with wd.watch("hung-dispatch"):
+            time.sleep(0.7)
+        assert fired, "watchdog did not fire"
+        label, msg = fired[0]
+        assert label == "hung-dispatch"
+        assert "hung-dispatch" in msg and "MainThread" in msg
+        assert wd.fired == "hung-dispatch"
+    finally:
+        wd.close()
+
+
+def test_watchdog_quiet_on_fast_regions():
+    fired = []
+    wd = Watchdog(0.5, on_timeout=lambda *a: fired.append(a),
+                  poll=0.05).start()
+    try:
+        for _ in range(5):
+            with wd.watch("quick"):
+                time.sleep(0.01)
+        time.sleep(0.2)  # idle time does not count against any region
+        assert not fired and wd.fired is None
+    finally:
+        wd.close()
+
+
+# -- checkpoint manager ---------------------------------------------------
+
+
+def _small_state():
+    return {"w": jax.numpy.arange(8, dtype=jax.numpy.float32),
+            "b": jax.numpy.ones((2, 3), dtype=jax.numpy.float32)}
+
+
+def _mgr(tmp, **kw):
+    kw.setdefault("retry_policy", FAST_RETRY)
+    return CheckpointManager(str(tmp), "run", **kw)
+
+
+def _corrupt_step(directory, step):
+    """Zero-truncate every file in a committed step dir — a torn write
+    that survived the atomic-rename protocol (e.g. zeroed-out blocks)."""
+    root = os.path.join(directory, str(step))
+    for dirpath, _, files in os.walk(root):
+        for name in files:
+            open(os.path.join(dirpath, name), "wb").close()
+
+
+def test_restore_empty_raises_typed(tmp_path):
+    mgr = _mgr(tmp_path)
+    with pytest.raises(CheckpointNotFoundError, match="no checkpoint"):
+        mgr.restore(_small_state())
+    mgr.close()
+
+
+def test_restore_explicit_missing_step_raises(tmp_path):
+    mgr = _mgr(tmp_path)
+    mgr.save(2, _small_state(), {"epoch": 0})
+    with pytest.raises(CheckpointNotFoundError, match="step 7"):
+        mgr.restore(_small_state(), step=7)
+    mgr.close()
+
+
+def test_restore_skips_corrupt_newest_and_resaves(tmp_path, capfd):
+    mgr = _mgr(tmp_path)
+    s = _small_state()
+    mgr.save(2, s, {"epoch": 0}, extra={"tag": 2})
+    mgr.save(4, s, {"epoch": 1}, extra={"tag": 4})
+    assert sorted(mgr.manager.all_steps()) == [2, 4]  # max_to_keep=2
+    _corrupt_step(mgr.directory, 4)
+
+    step, _, data_state, extra = mgr.restore(_small_state())
+    assert step == 2 and data_state == {"epoch": 0} and extra["tag"] == 2
+    assert "skipping unreadable checkpoint step 4" in capfd.readouterr().err
+    # the corrupt dir is QUARANTINED (moved aside, not deleted) and the
+    # step number is re-savable (Orbax's cached step list would
+    # otherwise silently skip the save)
+    assert mgr.manager.all_steps() == [2]
+    assert os.path.isdir(os.path.join(mgr.directory, "4.corrupt-0"))
+    mgr.save(4, s, {"epoch": 9}, extra={"tag": 44})
+    step, _, data_state, extra = mgr.restore(_small_state())
+    assert step == 4 and extra["tag"] == 44
+    mgr.close()
+
+
+def test_restore_all_corrupt_raises_typed_and_resaves(tmp_path):
+    mgr = _mgr(tmp_path)
+    mgr.save(2, _small_state(), {"epoch": 0})
+    _corrupt_step(mgr.directory, 2)
+    with pytest.raises(CheckpointNotFoundError, match="no valid"):
+        mgr.restore(_small_state())
+    # the corrupt dirs were purged and the manager reloaded, so the
+    # FRESH run that follows an all-corrupt fallthrough can re-save the
+    # same step numbers (Orbax's cached step list would silently skip)
+    mgr.save(2, _small_state(), {"epoch": 5})
+    step, _, data_state, _ = mgr.restore(_small_state())
+    assert step == 2 and data_state == {"epoch": 5}
+    mgr.close()
+
+
+def test_async_writer_retries_transient_oserror(tmp_path):
+    faults.install("checkpoint.write", "oserror", first=1, last=2)
+    mgr = _mgr(tmp_path)
+    mgr.save_async(3, _small_state(), {"epoch": 0})
+    mgr.wait()  # two injected failures were retried away
+    assert faults.hits("checkpoint.write") == 3
+    assert mgr.latest_step() == 3
+    mgr.close()
+
+
+def test_async_writer_device_get_retry(tmp_path):
+    faults.install("checkpoint.device_get", "oserror", first=1, last=1)
+    mgr = _mgr(tmp_path)
+    mgr.save_async(3, _small_state(), {"epoch": 0})
+    mgr.wait()
+    assert faults.hits("checkpoint.device_get") == 2
+    assert mgr.latest_step() == 3
+    mgr.close()
+
+
+def test_async_writer_exhausted_retries_surface_on_wait(tmp_path):
+    faults.install("checkpoint.write", "oserror")  # every attempt fails
+    mgr = _mgr(tmp_path)
+    mgr.save_async(3, _small_state(), {"epoch": 0})
+    with pytest.raises(RuntimeError, match="async checkpoint write failed"):
+        mgr.wait()
+    mgr.close()
+
+
+def test_coalescing_newest_wins_under_slow_inflight_write(tmp_path):
+    faults.install("checkpoint.write", "delay", arg=0.4, first=1, last=1)
+    mgr = _mgr(tmp_path)
+    s = _small_state()
+    mgr.save_async(1, s, {"epoch": 1})
+    time.sleep(0.05)  # let the writer pick up step 1 (now slow in-flight)
+    mgr.save_async(2, s, {"epoch": 2})  # PENDING...
+    mgr.save_async(3, s, {"epoch": 3})  # ...replaced by newest
+    mgr.wait()
+    steps = sorted(mgr.manager.all_steps())
+    assert 3 in steps and 2 not in steps  # step 2 coalesced away
+    mgr.close()
+
+
+def test_coalescing_newest_survives_failing_inflight_write(tmp_path):
+    # the in-flight write fails terminally (each attempt slow AND
+    # failing, so the newer save is enqueued while it is still dying);
+    # the error is latched and surfaced, but the newest pending save
+    # must still be written
+    faults.install("checkpoint.write", "delay", arg=0.1, first=1,
+                   last=FAST_RETRY.attempts)
+    faults.install("checkpoint.write", "oserror", first=1,
+                   last=FAST_RETRY.attempts)
+    mgr = _mgr(tmp_path)
+    s = _small_state()
+    mgr.save_async(1, s, {"epoch": 1})
+    time.sleep(0.05)
+    mgr.save_async(3, s, {"epoch": 3})
+    with pytest.raises(RuntimeError, match="async checkpoint write failed"):
+        mgr.wait()
+    mgr.wait()  # error was consumed; the newest save is durable
+    assert mgr.latest_step() == 3
+    mgr.close()
+
+
+def test_close_raises_on_hung_writer(tmp_path):
+    faults.install("checkpoint.write", "delay", arg=1.5, first=1, last=1)
+    mgr = _mgr(tmp_path, close_timeout=0.2)
+    mgr.save_async(1, _small_state(), {"epoch": 0})
+    time.sleep(0.05)
+    with pytest.raises(RuntimeError, match="writer thread still alive"):
+        mgr.close()
+    for _ in range(200):  # let the delayed write finish, then close cleanly
+        if not mgr._writer.is_alive():
+            break
+        time.sleep(0.1)
+    mgr.close()
+
+
+# -- CSVLogger resume -----------------------------------------------------
+
+
+def _log_rows(run_dir, steps, resume_step=0, comm=64.0):
+    lg = CSVLogger(max_steps=100, run_name="r", log_dir=str(run_dir),
+                   show_progress=False, resume_step=resume_step)
+    for s in steps:
+        lg.log_train(1.0 + s, lr=0.1, comm_bytes=comm, step=s)
+        lg.log_loss(2.0 + s, "local", step=s)
+    lg.sync()
+    lg.close()
+    return os.path.join(str(run_dir), "r")
+
+
+def _read(path):
+    with open(path) as f:
+        return f.read().splitlines()
+
+
+def test_csv_resume_preserves_history_and_cum_comm(tmp_path):
+    d = _log_rows(tmp_path, range(6))
+    # resume from step 3: rows 0-2 survive, 3-5 dropped (they will be
+    # re-logged by the resumed run), cum_comm continues from row 2
+    _log_rows(tmp_path, range(3, 6), resume_step=3)
+    rows = _read(os.path.join(d, "train.csv"))
+    assert [r.split(",")[0] for r in rows[1:]] == ["0", "1", "2", "3", "4",
+                                                  "5"]
+    cums = [float(r.split(",")[4]) for r in rows[1:]]
+    assert cums == [64.0 * (i + 1) for i in range(6)]  # continuous
+    vrows = _read(os.path.join(d, "validation.csv"))
+    assert [r.split(",")[0] for r in vrows[1:]] == ["0", "1", "2", "3", "4",
+                                                    "5"]
+
+
+def test_csv_resume_drops_torn_and_post_restore_rows(tmp_path):
+    d = _log_rows(tmp_path, range(4))
+    with open(os.path.join(d, "train.csv"), "a", newline="") as f:
+        f.write("9,1.25,0.1,64,640\n")  # durable row past restore point
+        f.write("1")  # torn final line: prefix of a row for step 10+
+    _log_rows(tmp_path, range(2, 4), resume_step=2)
+    rows = _read(os.path.join(d, "train.csv"))
+    assert [r.split(",")[0] for r in rows[1:]] == ["0", "1", "2", "3"]
+
+
+def test_csv_fresh_run_truncates(tmp_path):
+    d = _log_rows(tmp_path, range(4))
+    _log_rows(tmp_path, range(2), resume_step=0)
+    rows = _read(os.path.join(d, "train.csv"))
+    assert [r.split(",")[0] for r in rows[1:]] == ["0", "1"]
+
+
+# -- Trainer-level resume -------------------------------------------------
+
+
+def _fit(ds, max_steps, tmp, **kw):
+    kw.setdefault("checkpoint_interval", 3)
+    kw.setdefault("save_dir", tmp)
+    kw.setdefault("run_name", "resil")
+    return Trainer(TinyLossModel(), ds, None).fit(
+        strategy=SimpleReduceStrategy(OptimSpec("sgd", lr=0.05)),
+        num_nodes=2, max_steps=max_steps, batch_size=16, minibatch_size=8,
+        val_interval=0, show_progress=False, seed=3,
+        log_dir=os.path.join(tmp, "logs"),
+        **kw,
+    )
+
+
+def _train_csv(tmp):
+    with open(os.path.join(tmp, "logs", "resil", "train.csv")) as f:
+        return f.read()
+
+
+def test_fit_resumes_past_corrupt_newest_checkpoint(tmp_path):
+    """Acceptance: restore demonstrably skips a deliberately corrupted
+    newest checkpoint dir, resumes from the older one, and the stitched
+    trajectory is bit-identical to an uninterrupted run."""
+    ds = blobs(256, seed=5)
+    straight, resume = str(tmp_path / "s"), str(tmp_path / "r")
+    res_straight = _fit(ds, 10, straight)
+
+    _fit(ds, 5, resume)  # checkpoints at steps 3 and 5 (max_to_keep=2)
+    _corrupt_step(os.path.join(resume, "resil"), 5)
+    res = _fit(ds, 10, resume)
+
+    # genuinely fell back to step 3 (not 5): steps 3 and 4 were re-run
+    steps = [s for s, _ in res.history["train_loss"]]
+    assert min(steps) == 3 and max(steps) == 9
+    assert _train_csv(resume) == _train_csv(straight)
+    for a, b in zip(jax.tree.leaves(res_straight.params),
+                    jax.tree.leaves(res.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    shutil.rmtree(str(tmp_path), ignore_errors=True)
+
+
+def test_fit_default_run_name_resume_keeps_csv_history(tmp_path):
+    # with run_name=None and checkpointing on, the checkpoint store AND
+    # the CSV logger must agree on the pinned "default" run name — a
+    # resume that restores the checkpoint but opens a fresh
+    # run_<timestamp> log dir silently orphans the pre-crash history
+    ds = blobs(256, seed=5)
+    d = str(tmp_path / "noname")
+    _fit(ds, 5, d, run_name=None)
+    _fit(ds, 10, d, run_name=None)
+    path = os.path.join(d, "logs", "default", "train.csv")
+    with open(path) as f:
+        steps = [r.split(",")[0] for r in f.read().splitlines()[1:]]
+    assert steps == [str(i) for i in range(10)]
+    shutil.rmtree(str(tmp_path), ignore_errors=True)
+
+
+def test_fit_resume_never_starts_over(tmp_path):
+    ds = blobs(128, seed=6)
+    d = str(tmp_path / "fresh")
+    _fit(ds, 4, d)
+    res = _fit(ds, 4, d, resume="never")
+    steps = [s for s, _ in res.history["train_loss"]]
+    assert min(steps) == 0 and max(steps) == 3  # did not resume
+    # and the purged dir was re-populated by the fresh run's checkpoints
+    mgr = CheckpointManager(d, "resil")
+    assert mgr.latest_step() == 4
+    mgr.close()
+    shutil.rmtree(str(tmp_path), ignore_errors=True)
+
+
+def test_fit_resume_explicit_step_missing_raises(tmp_path):
+    ds = blobs(128, seed=6)
+    with pytest.raises(CheckpointNotFoundError):
+        _fit(ds, 4, str(tmp_path / "x"), resume=7)
+    shutil.rmtree(str(tmp_path), ignore_errors=True)
+
+
+def test_fit_resume_zero_is_a_step_pin_not_never(tmp_path):
+    # resume=0 must mean "checkpoint step 0" (missing → typed error),
+    # NOT fall into the `0 == False` purge-and-start-over path
+    ds = blobs(128, seed=6)
+    d = str(tmp_path / "zero")
+    _fit(ds, 4, d)
+    with pytest.raises(CheckpointNotFoundError):
+        _fit(ds, 4, d, resume=0)
+    # and the existing checkpoints were NOT purged by the attempt
+    mgr = CheckpointManager(d, "resil")
+    assert mgr.latest_step() == 4
+    mgr.close()
+    shutil.rmtree(str(tmp_path), ignore_errors=True)
+
+
+def test_fit_resume_step_without_checkpointing_raises(tmp_path):
+    ds = blobs(128, seed=6)
+    with pytest.raises(ValueError, match="requires save_dir"):
+        _fit(ds, 4, str(tmp_path / "x"), resume=7, checkpoint_interval=None,
+             save_dir=None)
+    with pytest.raises(ValueError, match="resume must be"):
+        _fit(ds, 4, str(tmp_path / "x"), resume="latest")
+    shutil.rmtree(str(tmp_path), ignore_errors=True)
+
+
+def test_fit_preempted_by_sigterm_emergency_checkpoint(tmp_path):
+    """In-process preemption drill: a SIGTERM delivered at a dispatch
+    boundary (via fault injection, so the timing is deterministic) makes
+    fit take one synchronous emergency checkpoint and return cleanly
+    with preempted=True; a later fit(resume='auto') continues to a
+    trajectory bit-identical to an uninterrupted run."""
+    ds = blobs(256, seed=5)
+    straight, pre = str(tmp_path / "s"), str(tmp_path / "p")
+    _fit(ds, 10, straight)
+
+    faults.install("dispatch.boundary", "sigterm", first=5, last=5)
+    res = _fit(ds, 10, pre)
+    faults.reset()
+    assert res.preempted and 0 < res.steps < 10
+    # the emergency checkpoint is the newest step and matches res.steps
+    mgr = CheckpointManager(pre, "resil")
+    assert mgr.latest_step() == res.steps
+    mgr.close()
+
+    res2 = _fit(ds, 10, pre)
+    assert not res2.preempted and res2.steps == 10
+    assert [s for s, _ in res2.history["train_loss"]][0] == res.steps
+    assert _train_csv(pre) == _train_csv(straight)
+    shutil.rmtree(str(tmp_path), ignore_errors=True)
