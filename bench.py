@@ -77,7 +77,9 @@ def _classify_and_report(blob: str, detail: str) -> int:
 
 def _supervise() -> int:
     """Probe the accelerator, then run the measurement under a watchdog."""
-    force_cpu = "--cpu" in sys.argv
+    # --sim-only is host-side by construction (the network is MODELED;
+    # the tiny measured fits are CPU-sized) — never touch the accelerator
+    force_cpu = "--cpu" in sys.argv or "--sim-only" in sys.argv
     if not force_cpu:
         probe_cmd = [sys.executable, "-c",
                      "import jax; print('PLATFORM=' + jax.devices()[0].platform)"]
@@ -107,8 +109,8 @@ def _supervise() -> int:
             return 0
     env = dict(os.environ)
     env["_GYM_TPU_BENCH_CHILD"] = "1"
-    if ("--overlap-only" in sys.argv or "--resilience-only" in sys.argv) \
-            and force_cpu:
+    if ("--overlap-only" in sys.argv or "--resilience-only" in sys.argv
+            or "--sim-only" in sys.argv) and force_cpu:
         # ablation-only CPU run: same 16-virtual-device layout the test
         # harness and _overlap_subprocess use (pre-init flag)
         env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=16 "
@@ -393,8 +395,51 @@ def _overlap_subprocess(timeout_s: int = 1800):
                 "tail": _timeout_tail(e)[-500:]}
 
 
+def measure_network_sim() -> dict:
+    """The ISSUE 3 rider: DiLoCo-vs-AllReduce simulated wall-clock on the
+    WAN and datacenter presets — a tiny real sweep (measured compute,
+    modeled comm) through ``gym_tpu.sim.sweep``. The headline number is
+    DiLoCo's simulated speedup over AllReduce on 1 Gbps WAN links."""
+    import contextlib
+    import tempfile
+
+    from gym_tpu.sim.sweep import SweepConfig, run_sweep
+
+    out = (os.environ.get("GYM_TPU_BENCH_SIM_DIR")
+           or tempfile.mkdtemp(prefix="gym_tpu_sim_bench_"))
+    cfg = SweepConfig(
+        strategies=["diloco", "simple_reduce"],
+        presets=["wan", "datacenter"],
+        nodes=[int(os.environ.get("GYM_TPU_BENCH_SIM_NODES", 4))],
+        H=[int(os.environ.get("GYM_TPU_BENCH_SIM_H", 10))],
+        steps=int(os.environ.get("GYM_TPU_BENCH_SIM_STEPS", 30)),
+        out=out,
+    )
+    with contextlib.redirect_stdout(sys.stderr):  # keep stdout one JSON line
+        rows = run_sweep(cfg)
+
+    def cell(strategy, preset):
+        return next(r for r in rows if r["strategy"] == strategy
+                    and r["topology"] == preset)
+
+    result = {"metric": "network_sim_diloco_vs_allreduce",
+              "workload": (f"2-layer GPT, {cfg.nodes[0]} nodes, "
+                           f"{cfg.steps} steps, H={cfg.H[0]}"),
+              "out_dir": out}
+    for preset in cfg.presets:
+        d, a = cell("diloco", preset), cell("simple_reduce", preset)
+        result[preset] = {
+            "diloco_sim_s": round(d["sim_total_s"], 3),
+            "allreduce_sim_s": round(a["sim_total_s"], 3),
+            "speedup": round(a["sim_total_s"] / d["sim_total_s"], 2)
+            if d["sim_total_s"] else None,
+            "traces_reconcile": bool(d["reconciled"] and a["reconciled"]),
+        }
+    return result
+
+
 def main() -> None:
-    force_cpu = "--cpu" in sys.argv
+    force_cpu = "--cpu" in sys.argv or "--sim-only" in sys.argv
     if force_cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
 
@@ -417,6 +462,10 @@ def main() -> None:
     if "--resilience-only" in sys.argv:
         print(json.dumps(
             {"resilience_overhead": measure_resilience_overhead()}))
+        return
+
+    if "--sim-only" in sys.argv:
+        print(json.dumps({"network_sim": measure_network_sim()}))
         return
 
     import numpy as np

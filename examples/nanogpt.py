@@ -202,6 +202,15 @@ def main():
                    help="persistent XLA compile cache (repeat runs skip "
                         "warmup compiles); also honors "
                         "JAX_COMPILATION_CACHE_DIR")
+    # network simulation (ISSUE 3): price the strategy's collective trace
+    # on a declarative topology and log sim_step_s/sim_total_s
+    p.add_argument("--network", default=None, metavar="PRESET",
+                   help="simulate this network topology (datacenter, wan, "
+                        "federated) — logs simulated per-step and total "
+                        "wall-clock alongside comm_bytes")
+    p.add_argument("--network_overlap", action="store_true",
+                   help="model perfect compute/comm overlap in the "
+                        "network simulation (default: comm serializes)")
     args = p.parse_args()
 
     if args.device == "cpu":
@@ -268,6 +277,8 @@ def main():
         prefetch=not args.no_prefetch,
         async_checkpoint=not args.sync_checkpoint,
         compilation_cache_dir=args.compilation_cache_dir,
+        network=args.network,
+        network_overlap=args.network_overlap,
         seed=args.seed,
         val_size=args.val_size,
         val_interval=args.val_interval,
@@ -276,6 +287,11 @@ def main():
     )
     print(f"final train loss {res.final_train_loss:.4f} "
           f"({res.steps_per_second:.2f} it/s)")
+    if res.sim is not None:
+        print(f"simulated on {res.sim['topology']}: "
+              f"{res.sim['sim_total_s']:.1f}s total "
+              f"({res.sim['sim_comm_s']:.1f}s comm, "
+              f"{res.sim['sim_compute_s']:.1f}s compute)")
 
     if args.sample:
         from gym_tpu.data.build_dataset import CHAR_VOCAB

@@ -1,0 +1,38 @@
+"""Network simulation + strategy-sweep subsystem (ISSUE 3).
+
+Turns the gym's per-step collective event traces
+(``strategy.base.CollectiveEvent``) into simulated wall-clock on
+declarative topologies:
+
+- ``topology``   — per-link bandwidth/latency networks + presets
+  ("datacenter", "wan" a.k.a. cross-region DiLoCo, "federated").
+- ``cost_model`` — alpha-beta timing for ring/tree all-reduce,
+  all-gather, reduce-scatter, broadcast, p2p.
+- ``simulator``  — modeled comm + measured compute → simulated step/run
+  wall-clock with an overlap toggle, plus the cost-vs-loss frontier.
+- ``sweep``      — resumable grid runner (strategy × H × nodes ×
+  topology) emitting CSV/JSON and a markdown comparison report;
+  ``python -m gym_tpu.sim.sweep --help``.
+
+Everything here is pure host-side Python over the analytic traces — no
+device required, closed-form unit-testable (``tests/test_sim.py``).
+"""
+
+from ..strategy.base import COLLECTIVE_OPS, CollectiveEvent
+from .cost_model import (collective_time, events_time, events_tx_bytes,
+                         p2p_time, ring_all_gather_time,
+                         ring_all_reduce_time, ring_reduce_scatter_time,
+                         tree_all_reduce_time, tree_broadcast_time)
+from .simulator import (NetworkSimulator, SimResult, loss_frontier,
+                        make_simulator)
+from .topology import PRESETS, Link, Topology, resolve_topology
+
+__all__ = [
+    "CollectiveEvent", "COLLECTIVE_OPS",
+    "Link", "Topology", "PRESETS", "resolve_topology",
+    "collective_time", "events_time", "events_tx_bytes",
+    "ring_all_reduce_time", "ring_all_gather_time",
+    "ring_reduce_scatter_time", "tree_all_reduce_time",
+    "tree_broadcast_time", "p2p_time",
+    "NetworkSimulator", "SimResult", "make_simulator", "loss_frontier",
+]

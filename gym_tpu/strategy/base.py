@@ -23,7 +23,8 @@ it; SURVEY §5.5).
 from __future__ import annotations
 
 import abc
-from typing import Any, Dict, Optional, Tuple
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -39,6 +40,65 @@ def tree_bytes(tree: PyTree) -> int:
     return int(
         sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(tree))
     )
+
+
+def comm_metric(x) -> jnp.ndarray:
+    """Canonical form of the per-step ``comm_bytes`` metric: a float32
+    scalar. Every strategy funnels its accounting through this one helper
+    so the host logging path sees one dtype/shape whatever the strategy
+    (the strategies used to return a mix of Python floats and jnp arrays;
+    ``tests/test_strategies.py`` asserts the invariant)."""
+    return jnp.asarray(x, jnp.float32).reshape(())
+
+
+# Collective op kinds a strategy step can schedule; the payload-size
+# convention per op (CollectiveEvent.bytes) is:
+#   all_reduce      — size of the vector being reduced
+#   reduce_scatter  — size of the full input vector (output is bytes/group)
+#   all_gather      — size of the assembled output (inputs bytes/group each)
+#   broadcast / p2p — size of the message
+COLLECTIVE_OPS = ("all_reduce", "all_gather", "reduce_scatter", "broadcast",
+                  "p2p")
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveEvent:
+    """One collective a strategy step performs, described analytically.
+
+    This is the structured upgrade of the scalar ``comm_bytes`` metric
+    (ISSUE 3): strategies describe WHAT they communicate (op kind, payload,
+    participant group) from the host via ``Strategy.comm_events(step, ...)``
+    so the network simulator (``gym_tpu.sim``) can price the same schedule
+    on any topology. ``per_node_tx()`` reproduces each strategy's in-step
+    ``comm_bytes`` accounting exactly, which is what makes trace totals
+    reconcile with the logged ``cum_comm_bytes`` column.
+    """
+
+    op: str                 # one of COLLECTIVE_OPS
+    bytes: float            # logical payload size (convention above)
+    group: int              # number of participating nodes
+    label: str = ""         # e.g. "grads", "outer_sync"
+    # Per-node transmitted bytes as the strategy's own comm_bytes metric
+    # counts them. None = the canonical ring formula for `op`; strategies
+    # whose accounting deliberately differs (DeMo counts its payload once,
+    # FedAvg islands count one model transmit) pin it explicitly.
+    tx_bytes: Optional[float] = None
+
+    def __post_init__(self):
+        if self.op not in COLLECTIVE_OPS:
+            raise ValueError(f"unknown collective op {self.op!r}; "
+                             f"expected one of {COLLECTIVE_OPS}")
+
+    def per_node_tx(self) -> float:
+        """Bytes this event puts on the wire per participating node."""
+        if self.tx_bytes is not None:
+            return float(self.tx_bytes)
+        g = max(int(self.group), 1)
+        if self.op == "all_reduce":
+            return 2.0 * (g - 1) / g * self.bytes
+        if self.op in ("all_gather", "reduce_scatter"):
+            return (g - 1) / g * self.bytes
+        return float(self.bytes)  # broadcast / p2p
 
 
 def tree_num_params(tree: PyTree) -> int:
@@ -131,6 +191,24 @@ class Strategy(abc.ABC):
         Returns (new_params, new_state, metrics). ``metrics`` must include
         ``comm_bytes`` (per-node bytes transmitted this step).
         """
+
+    # -- collective trace (host-side, pure) -------------------------------
+
+    def comm_events(self, step: int, params: PyTree,
+                    num_nodes: int) -> List[CollectiveEvent]:
+        """The collectives this strategy's ``step`` schedules at host step
+        ``step``, described analytically (op kind, payload bytes,
+        participant group). Pure host Python — called outside jit with a
+        concrete ``step``; ``params`` is a per-node pytree of arrays or
+        ``ShapeDtypeStruct``s (only shapes/dtypes are read). Cadence is
+        encoded by returning ``[]`` on steps with no communication.
+
+        Contract: summing ``per_node_tx()`` over the returned events must
+        equal the mean per-node ``comm_bytes`` metric the jitted step
+        reports at the same step (float32 rounding aside) — the simulator
+        relies on this to reconcile traces with the logged CSV.
+        """
+        return []
 
     # -- logging helpers --------------------------------------------------
 

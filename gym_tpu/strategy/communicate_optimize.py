@@ -21,7 +21,7 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 import jax.numpy as jnp
 import optax
 
-from .base import PyTree, Strategy
+from .base import CollectiveEvent, PyTree, Strategy, comm_metric
 from .optim import OptimSpec, ensure_optim_spec
 
 
@@ -40,6 +40,12 @@ class CommunicationModule(abc.ABC):
     @abc.abstractmethod
     def communicate(self, params, mstate, step, ctx):
         """Returns (new_params, new_mstate, comm_bytes)."""
+
+    def comm_events(self, step: int, params: PyTree,
+                    num_nodes: int) -> List[CollectiveEvent]:
+        """Host-side analytic trace of the collectives ``communicate``
+        runs at ``step`` (see ``Strategy.comm_events``)."""
+        return []
 
     def config(self) -> Dict[str, Any]:
         return {"module": type(self).__name__}
@@ -85,6 +91,22 @@ class CommunicateOptimizeStrategy(Strategy):
         (reference ``federated_averaging.py:108-111``)."""
         return None  # None = always
 
+    def _should_communicate_host(self, step: int) -> bool:
+        """Pure-Python twin of ``_should_communicate`` for the host-side
+        trace path (``comm_events`` runs outside jit, per logged step —
+        it must not build jnp scalars). Subclasses overriding the gate
+        override both."""
+        return True
+
+    def comm_events(self, step: int, params: PyTree,
+                    num_nodes: int) -> List[CollectiveEvent]:
+        if not self._should_communicate_host(step):
+            return []
+        events: List[CollectiveEvent] = []
+        for m in self.communication_modules:
+            events.extend(m.comm_events(step, params, num_nodes))
+        return events
+
     def step(self, grads, params, state, step, ctx):
         grads = self._maybe_clip(grads, ctx)
         updates, opt_state = self.tx.update(grads, state["opt"], params)
@@ -113,7 +135,7 @@ class CommunicateOptimizeStrategy(Strategy):
         return (
             params,
             {"opt": opt_state, "modules": mstates},
-            {"comm_bytes": comm},
+            {"comm_bytes": comm_metric(comm)},
         )
 
     def config(self):

@@ -25,7 +25,7 @@ chunk per direction) is reported per step, matching the reference's
 
 from __future__ import annotations
 
-from typing import Optional, Union
+from typing import List, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -34,7 +34,7 @@ from ..ops.dct import (codec_for, decode_chunks, dct_matrix, encode_chunks,
                        sparse_decode_chunks)
 from ..ops.topk_compress import (mean_weights, scatter_mean_decode,
                                  topk_compress)
-from .base import PyTree, Strategy
+from .base import CollectiveEvent, PyTree, Strategy, comm_metric
 from .optim import OptimSpec, ensure_optim_spec
 from .sharding import pipe_unwrap, pipe_wrap
 
@@ -337,10 +337,28 @@ class DeMoStrategy(Strategy):
         return (
             new_params,
             pipe_wrap({"delta": new_delta}, ctx),
-            {"comm_bytes": jnp.asarray(comm_tx, jnp.float32),
-             "comm_recv_bytes": jnp.asarray(
-                 comm_tx * (ctx.num_nodes - 1), jnp.float32)},
+            {"comm_bytes": comm_metric(comm_tx),
+             "comm_recv_bytes": comm_metric(
+                 comm_tx * (ctx.num_nodes - 1))},
         )
+
+    def comm_events(self, step: int, params: PyTree,
+                    num_nodes: int) -> List[CollectiveEvent]:
+        # One packed all_gather per tile signature, every step: each node
+        # contributes n_chunks·k picks of 8 bytes (f32 val + bitcast
+        # int32 idx). tx pinned to the payload-once accounting the step
+        # reports (the reference's data_transmit counter).
+        p_leaves = jax.tree.leaves(params)
+        codecs, groups = self._groups(p_leaves)
+        events = []
+        for (a, b), ids in groups.items():
+            n_chunks = sum(codecs[i].n_chunks for i in ids)
+            k = max(1, min(self.compression_topk, a * b))
+            payload = float(n_chunks * k * 8)
+            events.append(CollectiveEvent(
+                "all_gather", payload * num_nodes, num_nodes,
+                label=f"picks_{a}x{b}", tx_bytes=payload))
+        return events
 
     def config(self):
         cfg = super().config()

@@ -21,13 +21,13 @@ that makes the SPARTA×DiLoCo combo real (the reference imports a nonexistent
 
 from __future__ import annotations
 
-from typing import Optional, Union
+from typing import List, Optional, Union
 
 import jax
 import jax.numpy as jnp
 import optax
 
-from .base import PyTree, tree_bytes
+from .base import CollectiveEvent, PyTree, tree_bytes
 from .communicate_optimize import (CommunicateOptimizeStrategy,
                                    CommunicationModule)
 from .optim import OptimSpec, ensure_optim_spec
@@ -165,6 +165,28 @@ class DiLoCoCommunicator(CommunicationModule):
         if self.shard_outer:
             mstate = pipe_wrap(mstate, ctx)
         return params, mstate, comm
+
+    def comm_events(self, step: int, params: PyTree,
+                    num_nodes: int) -> List[CollectiveEvent]:
+        if num_nodes <= 1 or not (step % self.H == 0 and step > 0):
+            return []
+        psize = float(tree_bytes(params))
+        if self.shard_outer:
+            # round average + the extra all_gather that reassembles the
+            # sharded master: 3(K−1)/K·|θ| total (participation<1 is
+            # rejected with shard_outer at construction)
+            return [
+                CollectiveEvent("all_reduce", psize, num_nodes,
+                                label="outer_avg"),
+                CollectiveEvent("all_gather", psize, num_nodes,
+                                label="outer_master"),
+            ]
+        from .faults import host_participation, mean_ring_tx
+        group, frac = host_participation(self.fault_seed, step, num_nodes,
+                                         self.participation)
+        tx = None if frac >= 1.0 else mean_ring_tx(group, frac, psize)
+        return [CollectiveEvent("all_reduce", psize, group,
+                                label="outer_avg", tx_bytes=tx)]
 
     def config(self):
         cfg = {"module": "DiLoCoCommunicator", "H": self.H,

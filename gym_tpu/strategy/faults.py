@@ -60,6 +60,27 @@ def participation_round(seed: int, step, rate: float, ctx):
     return alive, me_alive, group
 
 
+def host_participation(seed: int, step: int, k: int, rate: float):
+    """Host-side twin of ``participation_round`` for the analytic trace
+    path (``Strategy.comm_events``): the SAME shared-PRNG draw, reduced
+    to ``(group alive-count, alive fraction)`` as plain Python numbers.
+    One implementation for every strategy — the jitted accounting and
+    the trace must never disagree on the fault draw."""
+    if rate >= 1.0:
+        return k, 1.0
+    import numpy as np
+    alive = np.asarray(alive_mask(seed, step, k, rate))
+    return int(alive.sum()), float(alive.mean())
+
+
+def mean_ring_tx(group: int, frac: float, nbytes: float) -> float:
+    """Mean per-node ring-all-reduce bytes under partial participation:
+    alive nodes pay ``ring_bytes(group, nbytes)``, dead nodes pay 0, and
+    the logged metric is the node MEAN — host twin of the jitted
+    ``me_alive * ring_bytes(group, ·)`` accounting."""
+    return frac * 2.0 * (group - 1) / max(group, 1) * nbytes
+
+
 def sync_alive(new: PyTree, old: PyTree, me_alive) -> PyTree:
     """Dead nodes miss the round: keep ``old`` where this node is down."""
     return jax.tree.map(

@@ -15,12 +15,12 @@ under the node axes. The periodic gate is a ``lax.cond`` on the step counter.
 
 from __future__ import annotations
 
-from typing import Optional, Union
+from typing import List, Optional, Union
 
 import jax
 import jax.numpy as jnp
 
-from .base import tree_bytes
+from .base import CollectiveEvent, PyTree, tree_bytes
 from .communicate_optimize import (CommunicateOptimizeStrategy,
                                    CommunicationModule)
 from .optim import OptimSpec
@@ -89,6 +89,25 @@ class AveragingCommunicator(CommunicationModule):
         # all_gather: each node transmits its full model once (:61-69)
         return avg, mstate, me_alive * psize
 
+    def comm_events(self, step: int, params: PyTree,
+                    num_nodes: int) -> List[CollectiveEvent]:
+        if num_nodes <= 1:
+            return []
+        psize = float(tree_bytes(params))
+        isl = self.island_size if self.island_size is not None else num_nodes
+        from .faults import host_participation, mean_ring_tx
+        group, frac = host_participation(self.fault_seed, step, num_nodes,
+                                         self.participation)
+        if isl >= num_nodes:
+            tx = None if frac >= 1.0 else mean_ring_tx(group, frac, psize)
+            return [CollectiveEvent("all_reduce", psize, group,
+                                    label="avg", tx_bytes=tx)]
+        # islands: all_gather within each island (assembled isl·|θ|); the
+        # metric counts one full-model transmit per alive node (:61-69)
+        return [CollectiveEvent("all_gather", float(isl) * psize,
+                                min(isl, group), label="island_avg",
+                                tx_bytes=frac * psize)]
+
     def config(self):
         cfg = {"module": "AveragingCommunicator",
                "island_size": self.island_size}
@@ -127,6 +146,9 @@ class FedAvgStrategy(CommunicateOptimizeStrategy):
     def _should_communicate(self, step):
         # reference gate: local_step % H == 0 and local_step > 0 (:108-111)
         return jnp.logical_and(step % self.H == 0, step > 0)
+
+    def _should_communicate_host(self, step: int) -> bool:
+        return step % self.H == 0 and step > 0
 
     def config(self):
         cfg = super().config()

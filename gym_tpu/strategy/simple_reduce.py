@@ -9,11 +9,12 @@ step.
 
 from __future__ import annotations
 
-from typing import Optional, Union
+from typing import List, Optional, Union
 
 import optax
 
-from .base import PyTree, Strategy, tree_bytes
+from .base import (CollectiveEvent, PyTree, Strategy, comm_metric,
+                   tree_bytes)
 from .optim import OptimSpec, ensure_optim_spec
 
 
@@ -45,4 +46,11 @@ class SimpleReduceStrategy(Strategy):
         params = optax.apply_updates(params, updates)
         k = ctx.num_nodes
         comm = 2.0 * (k - 1) / max(k, 1) * tree_bytes(grads)
-        return params, {"opt": opt_state}, {"comm_bytes": comm}
+        return params, {"opt": opt_state}, {"comm_bytes": comm_metric(comm)}
+
+    def comm_events(self, step: int, params: PyTree,
+                    num_nodes: int) -> List[CollectiveEvent]:
+        # one gradient all-reduce per step, every step (grads are
+        # shape-identical to params)
+        return [CollectiveEvent("all_reduce", float(tree_bytes(params)),
+                                num_nodes, label="grads")]

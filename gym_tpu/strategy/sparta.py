@@ -20,12 +20,12 @@ purpose.
 from __future__ import annotations
 
 import math
-from typing import Optional, Union
+from typing import List, Optional, Union
 
 import jax
 import jax.numpy as jnp
 
-from .base import PyTree
+from .base import CollectiveEvent, PyTree
 from .communicate_optimize import (CommunicateOptimizeStrategy,
                                    CommunicationModule)
 from .optim import OptimSpec
@@ -151,6 +151,33 @@ class SparseCommunicator(CommunicationModule):
             return exchange(params, mstate)
         return jax.lax.cond(step % self.interval == 0, exchange, skip,
                             params, mstate)
+
+    def comm_events(self, step: int, params: PyTree,
+                    num_nodes: int) -> List[CollectiveEvent]:
+        if num_nodes <= 1:
+            return []
+        if self.interval > 1 and step % self.interval != 0:
+            return []
+        import numpy as np
+
+        # The masks are shared-PRNG-deterministic given (seed, leaf,
+        # iteration), so the host trace counts the REALIZED masked bytes
+        # (not the expectation p·|θ|) — exactly what the jitted step's
+        # comm_bytes metric reports. Only shapes/dtypes of `params` are
+        # read; the mask arrays are transient host-side bools.
+        iteration = step // self.interval
+        nbytes = 0.0
+        for i, p in enumerate(jax.tree.leaves(params)):
+            m = self.index_selector.mask(
+                jax.ShapeDtypeStruct(p.shape, bool), i, iteration)
+            nbytes += (float(np.asarray(m, dtype=np.int64).sum())
+                       * np.dtype(p.dtype).itemsize)
+        from .faults import host_participation, mean_ring_tx
+        group, frac = host_participation(self.fault_seed, step, num_nodes,
+                                         self.participation)
+        tx = None if frac >= 1.0 else mean_ring_tx(group, frac, nbytes)
+        return [CollectiveEvent("all_reduce", nbytes, group,
+                                label="sparse_avg", tx_bytes=tx)]
 
     def config(self):
         cfg = {"module": "SparseCommunicator",

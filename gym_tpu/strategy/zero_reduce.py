@@ -27,7 +27,7 @@ flat parameter slice is a valid optax pytree.
 
 from __future__ import annotations
 
-from typing import Optional, Union
+from typing import List, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -35,7 +35,8 @@ import optax
 from jax import lax
 from jax.flatten_util import ravel_pytree
 
-from .base import PyTree, Strategy, tree_bytes
+from .base import (CollectiveEvent, PyTree, Strategy, comm_metric,
+                   tree_bytes)
 from .optim import OptimSpec, ensure_optim_spec
 from .sharding import pipe_unwrap, pipe_wrap, shard_size, unshard
 
@@ -127,5 +128,31 @@ class ZeroReduceStrategy(Strategy):
         return (
             new_params,
             pipe_wrap({"opt": opt_state}, ctx),
-            {"comm_bytes": jnp.asarray(comm, jnp.float32)},
+            {"comm_bytes": comm_metric(comm)},
         )
+
+    def _canonical_schedule(self) -> bool:
+        """Does the bound mesh run the reduce-scatter schedule? Mirrors
+        the dispatch in ``step``: a single pure node axis (n_virt == 1),
+        more than one node, and no pipeline-clip special case."""
+        ctx = self._ctx
+        return (ctx is not None and len(ctx.axes) == 1
+                and ctx.num_nodes > 1
+                and not (ctx.pp_axes and self.max_norm))
+
+    def comm_events(self, step: int, params: PyTree,
+                    num_nodes: int) -> List[CollectiveEvent]:
+        nbytes = float(tree_bytes(params))  # |g| == |θ|
+        if self._canonical_schedule():
+            return [
+                CollectiveEvent("reduce_scatter", nbytes, num_nodes,
+                                label="grads"),
+                CollectiveEvent("all_gather", nbytes, num_nodes,
+                                label="params"),
+            ]
+        # vnode/pipeline fallback: full pmean + slice, then reassembly
+        return [
+            CollectiveEvent("all_reduce", nbytes, num_nodes, label="grads"),
+            CollectiveEvent("all_gather", nbytes, num_nodes,
+                            label="params"),
+        ]

@@ -57,6 +57,11 @@ class FitResult:
     # reads the step actually reached, not max_steps. A later
     # fit(resume="auto") continues from exactly here.
     preempted: bool = False
+    # Network-simulation summary (fit(network=...)): modeled wall-clock
+    # totals for the whole run on the requested topology — sim_total_s,
+    # sim_comm_s, sim_compute_s, trace_tx_bytes. None when no network
+    # was simulated.
+    sim: Optional[Dict[str, Any]] = None
 
 
 def _model_config(module) -> Dict[str, Any]:
@@ -178,6 +183,8 @@ class Trainer:
         save_dir: Optional[str] = None,
         resume: Union[str, bool, int] = "auto",
         watchdog_timeout: Optional[float] = None,
+        network: Optional[Any] = None,
+        network_overlap: bool = False,
         init_params: Optional[Any] = None,
         seed: int = 42,
         wandb_project: Optional[str] = None,
@@ -603,6 +610,26 @@ class Trainer:
                 make_eval_step(eval_model, runtime.ctx), donate_state=False
             )
 
+        # Network simulation (ISSUE 3): price the strategy's analytic
+        # collective trace on a declarative topology and log simulated
+        # wall-clock alongside the measured run. Host-side only — the
+        # real dispatch is untouched.
+        net_sim = None
+        if network is not None:
+            if pipe_model is not None:
+                raise ValueError(
+                    "network= simulation is not supported with pp > 1 "
+                    "(the pipeline state layout hides the per-node "
+                    "parameter tree)")
+            from .sim import make_simulator
+            # per-node template: every params leaf carries a leading [K]
+            # node axis; only shapes/dtypes are read
+            net_template = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape[1:], x.dtype),
+                state.params)
+            net_sim = make_simulator(network, strategy, net_template,
+                                     num_nodes, overlap=network_overlap)
+
         # Per-node parameter count: state.params has a leading [K] node axis
         # shared by every leaf, so total // K is the per-node count.
         per_node_params = tree_num_params(state.params) // num_nodes
@@ -617,6 +644,12 @@ class Trainer:
             "mesh": {"physical": runtime.n_phys, "virtual": runtime.n_virt,
                      "cp": runtime.cp, "tp": runtime.tp, "ep": runtime.ep,
                      "pp": runtime.pp},
+            # namespaced: the topology dict carries its own num_nodes
+            # (the network's capacity, not the run's K) — splatting it
+            # at top level would shadow the run key above
+            **({"network": dict(net_sim.topology.config(),
+                                overlap=network_overlap)}
+               if net_sim is not None else {}),
             **strategy.config(),
         }
 
@@ -634,12 +667,13 @@ class Trainer:
                 max_steps, run_name, log_dir, config, show_progress,
                 resume_step=start_step,
                 resume_cum_comm=restored_extra.get("cum_comm_bytes"),
+                sim=net_sim is not None,
             )
 
         history: Dict[str, List] = {
             "train_loss": [], "local_loss": [], "global_loss": [],
             "comm_bytes": [], "comm_recv_bytes": [], "nonfinite": [],
-            "avg_model_correlation": [],
+            "avg_model_correlation": [], "sim_step_s": [],
         }
 
         corr_jit = None
@@ -735,15 +769,33 @@ class Trainer:
             # went non-finite this step)
             nf_a = (np.asarray(m["nonfinite"]).sum(axis=0).reshape(count)
                     if "nonfinite" in m else None)
+            # running compute-time estimate for the per-row simulated
+            # step clock (the steady window excludes compile; rows
+            # drained before it exists fall back to the whole-run rate).
+            # The end-of-run summary re-simulates every step with the
+            # final steady rate — that is the number to compare.
+            comp_est = None
+            if net_sim is not None:
+                now = time.perf_counter()
+                retired = first_idx + count
+                if t_steady is not None and retired > steady_from:
+                    comp_est = (now - t_steady) / (retired - steady_from)
+                else:
+                    comp_est = ((now - t_start)
+                                / max(1, retired - start_step))
             for j in range(count):
                 step_j = first_idx + j
                 loss = float(loss_a[j])
                 comm = float(comm_a[j])
                 last_loss = loss
+                sim_j = (net_sim.step_time(step_j, comp_est)
+                         if net_sim is not None else None)
                 logger.log_train(loss, strategy.lr_at(step_j), comm,
-                                 step=step_j)
+                                 step=step_j, sim_step_s=sim_j)
                 history["train_loss"].append((step_j, loss))
                 history["comm_bytes"].append((step_j, comm))
+                if sim_j is not None:
+                    history["sim_step_s"].append((step_j, sim_j))
                 if recv_a is not None:
                     history["comm_recv_bytes"].append(
                         (step_j, float(recv_a[j]))
@@ -1022,6 +1074,15 @@ class Trainer:
                 loss_model.module.config, mfu_params,
                 batch_size * num_nodes, elapsed / steps_done,
             )
+        sim_summary = None
+        if net_sim is not None:
+            # Re-simulate the FULL step range with the final steady
+            # compute rate: deterministic given the measured rate, and
+            # resume-safe (a resumed fit re-prices steps < start_step
+            # identically instead of carrying an accumulator).
+            comp_final = (1.0 / sps_steady if sps_steady
+                          else (elapsed / steps_done if steps_done else 0.0))
+            sim_summary = net_sim.simulate(end_step, comp_final).summary()
         logger.log_summary({
             "steps_per_second": steps_done / elapsed if elapsed else 0.0,
             "mfu": mfu,
@@ -1033,6 +1094,7 @@ class Trainer:
             ),
             "cum_comm_bytes": logger.cum_comm_bytes,
             "final_train_loss": last_loss,
+            **(sim_summary or {}),
         })
         if not preempted:
             run_eval()
@@ -1078,6 +1140,7 @@ class Trainer:
             node_state=state,
             steps=end_step,
             preempted=preempted,
+            sim=sim_summary,
             steps_per_second=(
                 steps_done / elapsed if elapsed > 0 else 0.0
             ),

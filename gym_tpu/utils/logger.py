@@ -39,11 +39,14 @@ class Logger:
 
     def log_train(self, loss: float, lr: float = 0.0,
                   comm_bytes: float = 0.0,
-                  step: Optional[int] = None) -> None:
+                  step: Optional[int] = None,
+                  sim_step_s: Optional[float] = None) -> None:
         """``step`` pins the record to the step the loss was COMPUTED at
         (the fit loop drains metrics one dispatch late for host overlap,
         so ``self.step`` has already moved on). Required for crash+resume
-        CSV stitching: rows are pruned/re-logged by true step."""
+        CSV stitching: rows are pruned/re-logged by true step.
+        ``sim_step_s`` is the network-simulated wall-clock for this step
+        (fit(network=...)); None when no network is simulated."""
         self.cum_comm_bytes += comm_bytes
         if self.pbar is not None:
             self.pbar.set_postfix(
@@ -144,7 +147,8 @@ class CSVLogger(Logger):
     def __init__(self, max_steps: int, run_name: Optional[str] = None,
                  log_dir: str = "logs", config: Optional[Dict] = None,
                  show_progress: bool = True, resume_step: int = 0,
-                 resume_cum_comm: Optional[float] = None):
+                 resume_cum_comm: Optional[float] = None,
+                 sim: bool = False):
         super().__init__(max_steps, show_progress)
         run_name = run_name or f"run_{int(time.time())}"
         self.run_dir = os.path.join(log_dir, run_name)
@@ -152,8 +156,18 @@ class CSVLogger(Logger):
         if config is not None:
             with open(os.path.join(self.run_dir, "config.json"), "w") as f:
                 json.dump(_jsonable(config), f, indent=2, default=str)
+        # network-simulated runs carry an extra per-row column; the
+        # header is fixed per run (resume keeps it consistent because
+        # fit(network=...) is pinned by the resumed call's arguments)
+        self._sim = bool(sim)
+        train_header = (self._TRAIN_HEADER + ["sim_step_s"] if self._sim
+                        else self._TRAIN_HEADER)
+        # both train formats (with/without the sim column) are valid
+        # pre-resume rows: a resumed fit that flips network= must not
+        # discard the run's whole history over one column
+        train_lens = {len(self._TRAIN_HEADER), len(self._TRAIN_HEADER) + 1}
         self._train_f, self._train_w, train_kept = self._open_csv(
-            "train.csv", self._TRAIN_HEADER, resume_step)
+            "train.csv", train_header, resume_step, ok_lens=train_lens)
         self._val_f, self._val_w, _ = self._open_csv(
             "validation.csv", self._VAL_HEADER, resume_step)
         # Comm accumulation continues across the resume so the cum column
@@ -170,13 +184,20 @@ class CSVLogger(Logger):
             except (ValueError, IndexError):
                 pass
 
-    def _open_csv(self, name: str, header, resume_step: int):
+    def _open_csv(self, name: str, header, resume_step: int,
+                  ok_lens=None):
         """(Re)open a CSV stream, keeping pre-restore rows on resume.
 
-        A kept row must have the full column count (a torn line from a
-        mid-write crash is a strict prefix, so it has fewer fields or an
-        intact step field that the ``< resume_step`` filter drops) and a
-        step strictly before the restored step.
+        A kept row must have a known column count (``ok_lens``; default
+        exactly the header's — a torn line from a mid-write crash is a
+        strict prefix, so it has fewer fields or an intact step field
+        that the ``< resume_step`` filter drops) and a step strictly
+        before the restored step. Rows from an alternate known format
+        are padded/truncated to the current header, so e.g. a resume
+        that toggles the network-sim column cannot discard the run's
+        whole history; torn rows stay excluded because every row a
+        checkpoint covers was fsynced complete, and anything after the
+        last fsync has a step the ``< resume_step`` filter drops.
 
         The filtered file is rewritten ATOMICALLY (temp + fsync +
         ``os.replace``) and then opened for append: truncating the
@@ -184,14 +205,15 @@ class CSVLogger(Logger):
         resume initialization destroys the entire prior history — the
         exact event this layer defends against."""
         path = os.path.join(self.run_dir, name)
+        ok_lens = ok_lens or {len(header)}
         kept = []
         if resume_step > 0 and os.path.exists(path):
             with open(path, newline="") as f:
                 rows = list(csv.reader(f))
             for r in rows[1:]:
                 try:
-                    if len(r) == len(header) and int(r[0]) < resume_step:
-                        kept.append(r)
+                    if len(r) in ok_lens and int(r[0]) < resume_step:
+                        kept.append((r + [""] * len(header))[:len(header)])
                 except ValueError:
                     continue  # unparseable (torn) row
         tmp = path + ".tmp"
@@ -206,13 +228,15 @@ class CSVLogger(Logger):
         w = csv.writer(f)
         return f, w, kept
 
-    def log_train(self, loss, lr=0.0, comm_bytes=0.0, step=None):
-        super().log_train(loss, lr, comm_bytes, step)
-        self._train_w.writerow(
-            [self.step if step is None else step, f"{loss:.6f}",
-             f"{lr:.8f}", f"{comm_bytes:.0f}",
-             f"{self.cum_comm_bytes:.0f}"]
-        )
+    def log_train(self, loss, lr=0.0, comm_bytes=0.0, step=None,
+                  sim_step_s=None):
+        super().log_train(loss, lr, comm_bytes, step, sim_step_s)
+        row = [self.step if step is None else step, f"{loss:.6f}",
+               f"{lr:.8f}", f"{comm_bytes:.0f}",
+               f"{self.cum_comm_bytes:.0f}"]
+        if self._sim:
+            row.append("" if sim_step_s is None else f"{sim_step_s:.6f}")
+        self._train_w.writerow(row)
 
     def log_loss(self, loss, name, step=None):
         super().log_loss(loss, name, step)
@@ -263,16 +287,18 @@ class WandbLogger(Logger):
             self._wandb = None
             self._run = None
 
-    def log_train(self, loss, lr=0.0, comm_bytes=0.0, step=None):
-        super().log_train(loss, lr, comm_bytes, step)
+    def log_train(self, loss, lr=0.0, comm_bytes=0.0, step=None,
+                  sim_step_s=None):
+        super().log_train(loss, lr, comm_bytes, step, sim_step_s)
         if self._run is not None:
-            self._run.log(
-                {"train/loss": loss,
-                 "train/perplexity": math.exp(min(loss, 20.0)),
-                 "lr": lr, "comm/bytes_step": comm_bytes,
-                 "comm/bytes_cum": self.cum_comm_bytes},
-                step=self.step if step is None else step,
-            )
+            payload = {"train/loss": loss,
+                       "train/perplexity": math.exp(min(loss, 20.0)),
+                       "lr": lr, "comm/bytes_step": comm_bytes,
+                       "comm/bytes_cum": self.cum_comm_bytes}
+            if sim_step_s is not None:
+                payload["sim/step_s"] = sim_step_s
+            self._run.log(payload,
+                          step=self.step if step is None else step)
 
     def log_loss(self, loss, name, step=None):
         super().log_loss(loss, name, step)

@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Network-simulation gate (ISSUE 3) — the sim/sweep unit suites plus one
+# tiny 2-strategy × 2-topology smoke sweep through the CLI entry point,
+# run NEXT TO scripts/ci_tier1.sh and scripts/ci_faults.sh. The unit
+# suites pin the cost-model closed forms, per-strategy traces, and the
+# trace-vs-cum_comm_bytes reconciliation on a real fit; the CLI sweep
+# proves `python -m gym_tpu.sim.sweep` end to end (grid, per-cell run
+# dirs, report with the DiLoCo-vs-AllReduce headline). CPU-only; the
+# smoke sweep is sized for <60 s on the 2-core container.
+#
+# Usage: scripts/ci_sim.sh   (from the repo root or anywhere)
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+rm -f /tmp/_sim.log
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_sim.py tests/test_sweep.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_sim.log
+rc=${PIPESTATUS[0]}
+echo SIM_DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' \
+    /tmp/_sim.log | tr -cd . | wc -c)
+[ "$rc" -ne 0 ] && exit "$rc"
+
+# CLI smoke sweep: fresh out dir (a stale one would resume-skip every
+# cell and test nothing), 2 strategies × 2 topologies, tiny steps.
+SWEEP_OUT=${GYM_TPU_CI_SWEEP_OUT:-/tmp/gym_tpu_ci_sweep}
+rm -rf "$SWEEP_OUT"
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m gym_tpu.sim.sweep \
+    --preset wan,datacenter --strategies diloco,simple_reduce \
+    --nodes 2 --steps 8 --batch_size 4 --block_size 32 \
+    --n_layer 1 --n_embd 32 --out "$SWEEP_OUT"
+rc=$?
+[ "$rc" -ne 0 ] && exit "$rc"
+grep -q "Headline: DiLoCo" "$SWEEP_OUT/report.md" || {
+    echo "ci_sim: sweep report missing the DiLoCo headline"; exit 1; }
+grep -q "RECONCILIATION FAILURES" "$SWEEP_OUT/report.md" && {
+    echo "ci_sim: trace/cum_comm_bytes reconciliation failed"; exit 1; }
+echo "ci_sim: OK (report at $SWEEP_OUT/report.md)"
+exit 0

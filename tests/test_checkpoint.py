@@ -70,6 +70,41 @@ def test_keep_latest_pruning(tmp_path):
     shutil.rmtree(str(tmp_path), ignore_errors=True)
 
 
+def test_torn_only_step_dir_quarantined_and_resaveable(tmp_path):
+    """The sweep's kill -9 resume path (ISSUE 3): when the ONLY step dir
+    on disk is torn wreckage (Orbax lists bare numeric dirs even without
+    their metadata), restore must quarantine it and raise
+    CheckpointNotFoundError — the fresh-start signal — and the same step
+    number must then be saveable again (not "Destination already
+    exists")."""
+    import os
+
+    from gym_tpu.utils.checkpoint import (CheckpointManager,
+                                          CheckpointNotFoundError)
+
+    d = str(tmp_path / "unc")
+    os.makedirs(os.path.join(d, "run", "4"))
+    with open(os.path.join(d, "run", "4", "garbage"), "w") as f:
+        f.write("partial write")
+    mgr = CheckpointManager(d, "run", async_save=False,
+                            retry_policy=_no_retries())
+    state = {"w": np.zeros((2, 2), np.float32)}
+    with pytest.raises(CheckpointNotFoundError, match="no valid"):
+        mgr.restore(state)
+    assert os.path.exists(os.path.join(d, "run", "4.corrupt-0"))
+    mgr.save(4, state, {"pos": 0})
+    assert mgr.latest_step() == 4
+    step, _, data_state, _ = mgr.restore(state)
+    assert step == 4 and data_state == {"pos": 0}
+    mgr.close()
+    shutil.rmtree(str(tmp_path), ignore_errors=True)
+
+
+def _no_retries():
+    from gym_tpu.utils.resilience import RetryPolicy
+    return RetryPolicy(attempts=1)
+
+
 @pytest.mark.slow
 def test_resume_matches_straight_run_demo(tmp_path):
     """Same oracle with DeMo: its strategy state is the pooled chunk-layout

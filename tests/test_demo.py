@@ -142,6 +142,10 @@ def test_demo_single_node_sign_sgd():
     for d in jax.tree.leaves(jax.device_get(state)["delta"]):
         np.testing.assert_allclose(d, 0.0, atol=1e-5)
     assert float(m["comm_bytes"][0]) == 8 * 8  # 1 chunk × 8 picks × 8 bytes
+    # normalized metric contract (strategy.base.comm_metric): f32 scalar
+    # per node, like every other strategy
+    assert m["comm_bytes"].dtype == np.float32
+    assert m["comm_recv_bytes"].dtype == np.float32
 
 
 def test_demo_multinode_averages_signs():

@@ -364,6 +364,37 @@ def test_csv_resume_preserves_history_and_cum_comm(tmp_path):
                                                     "5"]
 
 
+def test_csv_resume_survives_sim_column_toggle(tmp_path):
+    """A resumed fit that flips fit(network=...) changes the train.csv
+    column count by one; the resume filter must keep the other format's
+    pre-restore rows (padded/truncated to the new header), not silently
+    discard the run's whole history."""
+    # sim run (6 columns) resumed WITHOUT the sim column (5)
+    lg = CSVLogger(max_steps=100, run_name="r", log_dir=str(tmp_path),
+                   show_progress=False, sim=True)
+    for s in range(4):
+        lg.log_train(1.0 + s, lr=0.1, comm_bytes=64.0, step=s,
+                     sim_step_s=0.5)
+    lg.sync()
+    lg.close()
+    d = _log_rows(tmp_path, range(2, 4), resume_step=2)
+    rows = _read(os.path.join(d, "train.csv"))
+    assert [r.split(",")[0] for r in rows[1:]] == ["0", "1", "2", "3"]
+    assert all(len(r.split(",")) == 5 for r in rows[1:])
+    # and the reverse: plain rows kept when resuming WITH the sim column
+    lg = CSVLogger(max_steps=100, run_name="r", log_dir=str(tmp_path),
+                   show_progress=False, resume_step=3, sim=True)
+    lg.log_train(4.0, lr=0.1, comm_bytes=64.0, step=3, sim_step_s=0.25)
+    lg.sync()
+    lg.close()
+    rows = _read(os.path.join(d, "train.csv"))
+    assert [r.split(",")[0] for r in rows[1:]] == ["0", "1", "2", "3"]
+    assert rows[0].split(",")[-1] == "sim_step_s"
+    assert rows[-1].split(",")[-1] == "0.250000"
+    # old-format kept rows padded to the new width
+    assert all(len(r.split(",")) == 6 for r in rows[1:])
+
+
 def test_csv_resume_drops_torn_and_post_restore_rows(tmp_path):
     d = _log_rows(tmp_path, range(4))
     with open(os.path.join(d, "train.csv"), "a", newline="") as f:

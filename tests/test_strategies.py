@@ -45,6 +45,40 @@ def make_harness(strategy, num_nodes, params_np, max_steps=100,
     return rt, step_fn, params, state
 
 
+@pytest.mark.parametrize("strategy_fn", [
+    lambda: SimpleReduceStrategy(OptimSpec("sgd", lr=0.1)),
+    lambda: ZeroReduceStrategy(OptimSpec("sgd", lr=0.1)),
+    lambda: DiLoCoStrategy(optim_spec=OptimSpec("sgd", lr=0.1), H=2),
+    lambda: FedAvgStrategy(inner_optim=OptimSpec("sgd", lr=0.1), H=2),
+    lambda: SPARTAStrategy(inner_optim=OptimSpec("sgd", lr=0.1),
+                           p_sparta=0.5),
+    lambda: SPARTADiLoCoStrategy(optim_spec=OptimSpec("sgd", lr=0.1),
+                                 p_sparta=0.5, H=2),
+], ids=["simple_reduce", "zero_reduce", "diloco", "fedavg", "sparta",
+        "sparta_diloco"])
+def test_comm_bytes_metric_normalized(strategy_fn):
+    """Every strategy's comm_bytes metric flows through one helper
+    (strategy.base.comm_metric): float32, scalar per node — the
+    strategies used to return a mix of Python floats and jnp arrays,
+    which the logging/trace layers then had to special-case (ISSUE 3
+    satellite). DeMo is covered separately in test_demo.py (its step
+    needs the DCT harness)."""
+    K = 4
+    params0 = {"w": np.ones((K, 6), np.float32),
+               "b": np.ones((K, 3), np.float32)}
+    grads = {"w": np.ones((K, 6), np.float32),
+             "b": np.ones((K, 3), np.float32)}
+    strat = strategy_fn()
+    rt, step_fn, params, state = make_harness(strat, K, params0)
+    for t in (0, 2):
+        params, state, m = step_fn(params, state, grads, t)
+        comm = m["comm_bytes"]
+        # [K] after the harness gathers the per-node scalar metric
+        assert comm.shape == (K,), comm.shape
+        assert comm.dtype == np.float32, comm.dtype
+        assert np.all(np.isfinite(comm))
+
+
 def test_simple_reduce_is_grad_average():
     """K-node SimpleReduce with per-node grads g_k must equal a single
     SGD step on mean(g_k) — DDP correctness (reference strategy.py:128-142)."""
