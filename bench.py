@@ -438,6 +438,109 @@ def measure_network_sim() -> dict:
     return result
 
 
+def measure_serving() -> dict:
+    """The ISSUE 4 headline: aggregate tokens/s of the continuous-batching
+    engine (``gym_tpu.serve``) vs sequentially looping ``generate_fast``
+    over the SAME mixed prompt/output-length request set.
+
+    The workload is genuinely mixed — every request draws a DISTINCT
+    ``(prompt_len, max_new_tokens)`` signature, which is what live
+    traffic looks like. That regime is exactly what the engine exists
+    for: ``generate_fast`` compiles one program per signature (N
+    requests → N multi-second XLA compiles; its lru cache never
+    saturates under live traffic), while the engine's compile set is
+    BOUNDED — one decode program plus at most ``⌈log2(block_size)⌉ + 1``
+    prefill buckets — so the headline times each arm END TO END from a
+    cold program cache, compiles included, the way a serving process
+    actually experiences the workload. (The JAX persistent compile cache
+    is disabled for this measurement; see main().)
+
+    A second, warm pass of each arm is reported alongside
+    (``*_warm_tok_s``): it isolates steady-state decode mechanics with
+    every program already compiled. On this 2-core CPU the warm arms are
+    within ~1.25x of each other — a b=8 decode step costs ~5x a b=1 step
+    here (per-row attention over the static cache dominates; there is no
+    under-utilized MXU to fill), so batching pays modestly; on an
+    accelerator the batch dimension is where the win scales."""
+    import numpy as np
+
+    from gym_tpu.models.nanogpt import GPT, GPTConfig, generate_fast
+    from gym_tpu.serve.engine import InferenceEngine, SamplingParams
+    from gym_tpu.serve.scheduler import Scheduler
+
+    num_slots = int(os.environ.get("GYM_TPU_BENCH_SERVE_SLOTS", 8))
+    n_req = int(os.environ.get("GYM_TPU_BENCH_SERVE_REQUESTS", 12))
+    chunk = int(os.environ.get("GYM_TPU_BENCH_SERVE_CHUNK", 8))
+    cfg = GPTConfig(block_size=256, vocab_size=65, n_layer=4, n_head=4,
+                    n_embd=128, dropout=0.0, bias=True)
+    model = GPT(cfg)
+    import jax
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        np.zeros((1, 8), np.int64), train=False)["params"]
+
+    # distinct (prompt_len, max_new) per request — live-traffic shape mix
+    rng = np.random.default_rng(0)
+    sigs = set()
+    while len(sigs) < n_req:
+        sigs.add((int(rng.integers(4, 48)), int(rng.integers(8, 40))))
+    workload = [
+        (rng.integers(0, cfg.vocab_size, plen), SamplingParams(
+            max_new_tokens=mnew, temperature=0.9, top_k=16, seed=i))
+        for i, (plen, mnew) in enumerate(sorted(sigs))
+    ]
+    total_new = sum(sp.max_new_tokens for _, sp in workload)
+
+    def run_sequential():
+        for prompt, sp in workload:
+            out = generate_fast(params, cfg, prompt[None],
+                                sp.max_new_tokens,
+                                temperature=sp.temperature,
+                                top_k=sp.top_k, seed=sp.seed)
+            assert out.shape[1] == len(prompt) + sp.max_new_tokens
+
+    engine = InferenceEngine(params, cfg, num_slots=num_slots,
+                             decode_chunk=chunk)
+
+    def run_engine():
+        sched = Scheduler(engine, max_queue=len(workload))
+        handles = [sched.submit(p, sp) for p, sp in workload]
+        while any(h.status.value in ("queued", "running")
+                  for h in handles):
+            sched.step()
+        for h in handles:
+            assert len(h.result()) == h.sampling.max_new_tokens
+
+    def timed(fn):
+        t0 = time.perf_counter()
+        fn()
+        return time.perf_counter() - t0
+
+    # cold pass per arm (the headline: serve the workload end to end,
+    # compiles included), then a warm pass (steady-state mechanics)
+    seq_cold = timed(run_sequential)
+    eng_cold = timed(run_engine)
+    seq_warm = timed(run_sequential)
+    eng_warm = timed(run_engine)
+    return {
+        "metric": "serving_continuous_batching_vs_sequential_tokens_per_s",
+        "workload": (f"{n_req} requests, distinct (prompt_len in [4,48), "
+                     f"max_new in [8,40)) signatures, gpt "
+                     f"{cfg.n_layer}L/{cfg.n_embd}d block "
+                     f"{cfg.block_size}, {num_slots} slots, "
+                     f"chunk {chunk}"),
+        "timing": "cold_process_compiles_included; warm = second pass",
+        "sequential_tok_s": round(total_new / seq_cold, 1),
+        "engine_tok_s": round(total_new / eng_cold, 1),
+        "speedup": round(seq_cold / eng_cold, 2),
+        "sequential_warm_tok_s": round(total_new / seq_warm, 1),
+        "engine_warm_tok_s": round(total_new / eng_warm, 1),
+        "warm_speedup": round(seq_warm / eng_warm, 2),
+        "sequential_programs_compiled": len(workload),
+        "engine_prefill_compiles": engine.stats.prefill_compiles,
+        "prefill_bound": (cfg.block_size - 1).bit_length() + 1,
+    }
+
+
 def main() -> None:
     force_cpu = "--cpu" in sys.argv or "--sim-only" in sys.argv
     if force_cpu:
@@ -451,7 +554,11 @@ def main() -> None:
     # Persistent XLA compile cache: a repeated bench invocation of the
     # same program skips the ~40 s warmup compile entirely. Opt out with
     # GYM_TPU_BENCH_COMPILE_CACHE=0 (e.g. to measure cold compiles).
-    if os.environ.get("GYM_TPU_BENCH_COMPILE_CACHE", "1") == "1":
+    # --serve-only NEVER uses it: its headline measures exactly the
+    # compile behavior a serving process sees (a warm persistent cache
+    # would quietly turn the cold arms warm on the second invocation).
+    if (os.environ.get("GYM_TPU_BENCH_COMPILE_CACHE", "1") == "1"
+            and "--serve-only" not in sys.argv):
         from gym_tpu.utils.compile_cache import enable_compilation_cache
         enable_compilation_cache(os.environ.get("GYM_TPU_BENCH_CACHE_DIR"))
 
@@ -466,6 +573,10 @@ def main() -> None:
 
     if "--sim-only" in sys.argv:
         print(json.dumps({"network_sim": measure_network_sim()}))
+        return
+
+    if "--serve-only" in sys.argv:
+        print(json.dumps({"serving": measure_serving()}))
         return
 
     import numpy as np

@@ -189,7 +189,13 @@ def main():
                    help="quarantine non-finite per-node gradients")
     p.add_argument("--sample", type=int, default=0, metavar="N",
                    help="after training, sample N tokens from the "
-                        "node-averaged model (KV-cache decoder)")
+                        "node-averaged model (KV-cache decoder); with "
+                        "--ckpt, sample from that run dir instead of "
+                        "training")
+    p.add_argument("--ckpt", default=None, metavar="RUN_DIR",
+                   help="skip training: params-only restore from this "
+                        "checkpoint run dir (fit save_dir/<run_name>) "
+                        "and --sample from it")
     # host-overlap pipeline knobs (ISSUE 1) — overlap is the default;
     # the flags select the serial paths for A/Bs and debugging
     p.add_argument("--no_prefetch", action="store_true",
@@ -212,6 +218,26 @@ def main():
                    help="model perfect compute/comm overlap in the "
                         "network simulation (default: comm serializes)")
     args = p.parse_args()
+
+    if args.ckpt:
+        # sampling-only mode: params-only restore (gym_tpu.serve.load) —
+        # no optimizer-state template, no dataset, no training. A missing
+        # or fully corrupt run dir is a one-line message, not a traceback.
+        from gym_tpu.serve.load import load_for_serving
+        from gym_tpu.utils.checkpoint import CheckpointNotFoundError
+        try:
+            params, cfg, info = load_for_serving(args.ckpt)
+        except (CheckpointNotFoundError, FileNotFoundError,
+                ValueError) as e:
+            # ValueError covers a non-GPT config.json or a num_nodes /
+            # node-axis mismatch — same one-line contract, no traceback
+            raise SystemExit(f"nanogpt: cannot sample from {args.ckpt}: "
+                             f"{e}")
+        print(f"restored step {info['step']} "
+              f"({info['num_nodes']}-node average) from {args.ckpt}")
+        _print_sample(params, cfg, cfg.vocab_size,
+                      args.sample or 200, args.seed)
+        return
 
     if args.device == "cpu":
         # pin the platform LIST, not just the device choice: initializing
@@ -294,25 +320,33 @@ def main():
               f"{res.sim['sim_compute_s']:.1f}s compute)")
 
     if args.sample:
-        from gym_tpu.data.build_dataset import CHAR_VOCAB
-        from gym_tpu.models.nanogpt import generate_fast
+        _print_sample(res.params, cfg, int(vocab_size), args.sample,
+                      args.seed)
 
-        prompt = np.zeros((1, 1), np.int64)  # start from token 0
-        n_new = min(args.sample, cfg.block_size - 1)  # KV-cache capacity
-        if n_new < args.sample:
-            print(f"(clamping sample to {n_new} tokens — the KV cache "
-                  f"holds block_size={cfg.block_size})")
-        out = generate_fast(res.params, cfg, prompt, n_new,
-                            temperature=0.8, top_k=40, seed=args.seed)
-        toks = out[0, 1:].tolist()
-        if int(vocab_size) <= len(CHAR_VOCAB) + 1:  # char-level corpus
-            text = "".join(CHAR_VOCAB[t] if t < len(CHAR_VOCAB) else ""
-                           for t in toks)
-            print("--- sample ---")
-            print(text)
-        else:
-            print("--- sample (token ids) ---")
-            print(toks)
+
+def _print_sample(params, cfg, vocab_size: int, n: int, seed: int) -> None:
+    """Sample ``n`` tokens from token 0 via the KV-cache decoder and print
+    them — as text for char-level corpora, token ids otherwise. Shared by
+    the post-training path and ``--ckpt`` sampling-only mode."""
+    from gym_tpu.data.build_dataset import CHAR_VOCAB
+    from gym_tpu.models.nanogpt import generate_fast
+
+    prompt = np.zeros((1, 1), np.int64)  # start from token 0
+    n_new = min(n, cfg.block_size - 1)  # KV-cache capacity
+    if n_new < n:
+        print(f"(clamping sample to {n_new} tokens — the KV cache "
+              f"holds block_size={cfg.block_size})")
+    out = generate_fast(params, cfg, prompt, n_new,
+                        temperature=0.8, top_k=40, seed=seed)
+    toks = out[0, 1:].tolist()
+    if int(vocab_size) <= len(CHAR_VOCAB) + 1:  # char-level corpus
+        text = "".join(CHAR_VOCAB[t] if t < len(CHAR_VOCAB) else ""
+                       for t in toks)
+        print("--- sample ---")
+        print(text)
+    else:
+        print("--- sample (token ids) ---")
+        print(toks)
 
 
 if __name__ == "__main__":

@@ -184,9 +184,19 @@ class CausalSelfAttention(nn.Module):
         return y
 
     def _decode_attend(self, q, k, v, b, t, hd):
-        """KV-cache attention: append this chunk's K/V at the cache cursor
-        and attend each query over everything written so far. Works for a
-        multi-token prefill chunk and the 1-token decode steps alike."""
+        """KV-cache attention: append this chunk's K/V at each row's cache
+        cursor and attend each query over everything its row has written so
+        far. Works for a multi-token prefill chunk and the 1-token decode
+        steps alike.
+
+        The cursor is PER ROW ([b] int32, not a scalar): every batch row is
+        an independent sequence at its own position. Single-request
+        ``generate_fast`` advances all rows in lockstep (scalar semantics
+        recovered exactly); the serving engine (``gym_tpu/serve``) maps
+        rows to request slots at different positions — continuous batching
+        needs nothing more from the model than this masked per-row attend
+        plus per-row cache resets (``serve/engine.py`` scatters a freshly
+        prefillled slot row into the cache and rewinds its cursor)."""
         cfg = self.config
         H, S = cfg.n_head, cfg.block_size
 
@@ -199,26 +209,32 @@ class CausalSelfAttention(nn.Module):
         cv = self.variable("cache", "v",
                            lambda: jnp.zeros((b, S, H, hd), q.dtype))
         ci = self.variable("cache", "i",
-                           lambda: jnp.zeros((), jnp.int32))
-        i = ci.value
-        k_all = jax.lax.dynamic_update_slice(ck.value, k, (0, i, 0, 0))
-        v_all = jax.lax.dynamic_update_slice(cv.value, v, (0, i, 0, 0))
+                           lambda: jnp.zeros((b,), jnp.int32))
+        i = ci.value                                    # [b] per-row cursor
+        rows = jnp.arange(b)[:, None]                   # [b, 1]
+        wpos = i[:, None] + jnp.arange(t)[None, :]      # [b, t] write pos
+        # overflow writes are clamped in-bounds (the scatter would silently
+        # drop them; clamping keeps it deterministic) — the row's output is
+        # poisoned below either way
+        k_all = ck.value.at[rows, jnp.minimum(wpos, S - 1)].set(k)
+        v_all = cv.value.at[rows, jnp.minimum(wpos, S - 1)].set(v)
         ck.value, cv.value, ci.value = k_all, v_all, i + t
 
         # scores over the FULL cache (static shape S); mask out unwritten
-        # slots and the causal future within this chunk
+        # slots and the causal future within this chunk, per row
         att = jnp.einsum("bqhd,bkhd->bhqk", q, k_all) / math.sqrt(hd)
-        row_pos = i + jnp.arange(t)[:, None]          # absolute query pos
-        col_pos = jnp.arange(S)[None, :]
-        mask = col_pos <= row_pos                      # [t, S]
-        att = jnp.where(mask[None, None], att.astype(jnp.float32),
+        col_pos = jnp.arange(S)                         # [S]
+        mask = col_pos[None, None, :] <= wpos[:, :, None]   # [b, t, S]
+        att = jnp.where(mask[:, None], att.astype(jnp.float32),
                         -jnp.inf)
         att = jax.nn.softmax(att, axis=-1).astype(q.dtype)
         y = jnp.einsum("bhqk,bkhd->bqhd", att, v_all)
-        # cache overflow (cursor past block_size) would silently clamp the
-        # dynamic_update_slice and overwrite recent K/V — poison the output
-        # instead so the failure is loud (a traced cursor can't `assert`)
-        y = jnp.where(i + t <= S, y, jnp.nan)
+        # cache overflow (cursor past block_size) would silently overwrite
+        # recent K/V — poison that ROW's output instead so the failure is
+        # loud (a traced cursor can't `assert`) without touching the other
+        # rows (a full slot must not poison its batch neighbors)
+        ok = (i + t <= S)[:, None, None, None]
+        y = jnp.where(ok, y, jnp.nan)
         return y.reshape(b, t, H * hd)
 
 
@@ -306,9 +322,11 @@ class GPT(nn.Module):
             assert cfg.seq_axis is None and targets is None, (
                 "decode mode is single-device, logits-only"
             )
+            # per-row position cursor, mirroring the per-row cache cursor
+            # in _decode_attend (rows are independent request slots)
             pcache = self.variable("cache", "pos",
-                                   lambda: jnp.zeros((), jnp.int32))
-            pos = pcache.value + jnp.arange(t)[None, :]
+                                   lambda: jnp.zeros((b,), jnp.int32))
+            pos = pcache.value[:, None] + jnp.arange(t)[None, :]
             pcache.value = pcache.value + t
         elif cfg.seq_axis is not None:
             # chunked sequences only see their own K/V under dense/flash —
@@ -525,12 +543,77 @@ def node_mfu(config: GPTConfig, node_params: Any, seqs_per_iter: float,
                         peak_flops=peak_flops, n_params=n_active)
 
 
+def decode_config(config: GPTConfig) -> GPTConfig:
+    """Sanitize a TRAINING config for single-device KV-cache decode — THE
+    shared rule for ``generate_fast`` and the serving engine
+    (``gym_tpu/serve/engine.py``), so a config captured from any ``fit``
+    run decodes correctly: dropout off, dense attention (no ring/flash —
+    decode queries one token), no sequence sharding, no remat, and
+    ``moe_impl`` reset to 'auto' alongside ``expert_axis=None`` — a
+    training config pinned to the capacity-limited 'einsum' dispatch must
+    not drop tokens at decode (capacity is tiny at T=1), and with
+    ``expert_axis`` cleared the drop-free ragged/dense paths are always
+    legal."""
+    return dataclasses.replace(config, decode=True, dropout=0.0,
+                               attn_impl="dense", seq_axis=None,
+                               remat=False, expert_axis=None,
+                               moe_impl="auto")
+
+
+def sample_logits(logits, key, temperature=1.0, top_k=None, top_p=None):
+    """Temperature → top-k → top-p (nucleus) → categorical, in f32: THE
+    sampling kernel shared by ``generate_fast`` and the serving engine
+    (``gym_tpu/serve/engine.py``).
+
+    ``logits`` is [..., V]; one ``key`` covers the whole call (batch rows
+    share its random bits — the engine vmaps this function to give each
+    request slot its own key). ``temperature``/``top_k``/``top_p`` may be
+    static python scalars (``None`` disables a filter) or traced arrays
+    broadcastable against ``logits[..., :1]``; the array encodings for
+    "disabled" are ``top_k >= V`` and ``top_p >= 1``, which reduce to
+    no-op ``where``s and reproduce the static-``None`` paths bit-exactly
+    — the single-request engine-vs-``generate_fast`` oracle depends on
+    this."""
+    v = logits.shape[-1]
+    logits = logits.astype(jnp.float32) / temperature
+    k = v if top_k is None else jnp.clip(top_k, 1, v)
+    srt = jnp.sort(logits, axis=-1)[..., ::-1]        # descending
+    kidx = jnp.broadcast_to(jnp.asarray(k - 1, jnp.int32),
+                            (*logits.shape[:-1], 1))
+    kth = jnp.take_along_axis(srt, kidx, axis=-1)
+    logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        # nucleus over the (already top-k-filtered) distribution: keep the
+        # smallest prefix of descending-prob tokens whose EXCLUSIVE
+        # cumulative mass stays under top_p (the top token is always kept)
+        srt = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(srt, axis=-1)          # -inf rows → 0
+        cum = jnp.cumsum(probs, axis=-1) - probs      # exclusive prefix
+        p_eff = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32),
+                                 (*logits.shape[:-1], 1))
+        # p >= 1 means disabled and must keep EVERY token (f32 cumsum can
+        # round to exactly 1.0 mid-tail, which `< 1.0` would truncate)
+        keep = cum < jnp.where(p_eff >= 1.0, jnp.inf, p_eff)
+        n_keep = jnp.maximum(jnp.sum(keep, axis=-1, keepdims=True), 1)
+        thr = jnp.take_along_axis(srt, n_keep - 1, axis=-1)
+        logits = jnp.where(logits < thr, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
 def generate(params: Any, config: GPTConfig, idx: np.ndarray,
              max_new_tokens: int, temperature: float = 1.0,
-             top_k: Optional[int] = None, seed: int = 0) -> np.ndarray:
+             top_k: Optional[int] = None, top_p: Optional[float] = None,
+             seed: int = 0) -> np.ndarray:
     """Autoregressive sampling (reference ``:410-439``): crop context to
-    block_size, temperature-scale, optional top-k filter, categorical
-    sample."""
+    block_size, temperature-scale, optional top-k / top-p (nucleus)
+    filters, categorical sample.
+
+    Context handling is the reference's: the context is CROPPED to the
+    last ``block_size`` tokens each step, so generation continues past
+    the window (with a sliding context). This is the documented fallback
+    when ``prompt + max_new_tokens`` exceeds ``block_size`` —
+    ``generate_fast``'s KV cache cannot slide and raises ``ValueError``
+    for that regime."""
     model = GPT(config)
 
     @jax.jit
@@ -546,6 +629,16 @@ def generate(params: Any, config: GPTConfig, idx: np.ndarray,
         if top_k is not None:
             kth = np.sort(logits, axis=-1)[:, -min(top_k, logits.shape[-1])]
             logits = np.where(logits < kth[:, None], -np.inf, logits)
+        if top_p is not None and top_p < 1.0:
+            # same convention as sample_logits: exclusive cumulative mass
+            # under top_p, top token always kept, ties at the threshold in
+            srt = np.sort(logits, axis=-1)[:, ::-1]
+            e = np.exp(srt - srt[:, :1])
+            probs = e / e.sum(axis=-1, keepdims=True)
+            cum = np.cumsum(probs, axis=-1) - probs
+            n_keep = np.maximum((cum < top_p).sum(axis=-1), 1)
+            thr = np.take_along_axis(srt, (n_keep - 1)[:, None], axis=-1)
+            logits = np.where(logits < thr, -np.inf, logits)
         key, sub = jax.random.split(key)
         nxt = jax.random.categorical(sub, jnp.asarray(logits), axis=-1)
         idx = np.concatenate([idx, np.asarray(nxt)[:, None]], axis=1)
@@ -554,7 +647,8 @@ def generate(params: Any, config: GPTConfig, idx: np.ndarray,
 
 def generate_fast(params: Any, config: GPTConfig, idx: np.ndarray,
                   max_new_tokens: int, temperature: float = 1.0,
-                  top_k: Optional[int] = None, seed: int = 0) -> np.ndarray:
+                  top_k: Optional[int] = None, top_p: Optional[float] = None,
+                  seed: int = 0) -> np.ndarray:
     """KV-cache autoregressive sampling (beyond-reference perf: the
     reference's ``generate`` — and our parity ``generate`` above — re-runs
     the full context per token, ``nanogpt.py:410-439``).
@@ -562,24 +656,24 @@ def generate_fast(params: Any, config: GPTConfig, idx: np.ndarray,
     One jitted program: prefill fills the per-layer K/V caches from the
     prompt, then a ``lax.scan`` samples token-by-token with O(T) attention
     per step. Same sampling semantics as ``generate`` (temperature,
-    optional top-k, categorical)."""
+    optional top-k / top-p, categorical); the per-token key schedule is
+    ``fold_in(PRNGKey(seed), j)`` so the j-th token's key does not depend
+    on ``max_new_tokens`` — the serving engine reproduces it token by
+    token for the single-request parity oracle."""
     idx = np.asarray(idx)
     b, t0 = idx.shape
-    assert t0 + max_new_tokens <= config.block_size, (
-        f"prompt {t0} + {max_new_tokens} new tokens exceeds the cache "
-        f"(block_size {config.block_size})"
-    )
-    # moe_impl reset to 'auto' alongside expert_axis=None: a training
-    # config pinned to the capacity-limited 'einsum' dispatch must not
-    # drop tokens at decode (capacity is tiny at T=1), and with
-    # expert_axis cleared the drop-free ragged/dense paths are always legal
-    cfg = dataclasses.replace(config, decode=True, dropout=0.0,
-                              attn_impl="dense", seq_axis=None,
-                              remat=False, expert_axis=None,
-                              moe_impl="auto")
+    if t0 + max_new_tokens > config.block_size:
+        raise ValueError(
+            f"prompt {t0} + {max_new_tokens} new tokens exceeds the KV "
+            f"cache (block_size {config.block_size}); crop the prompt to "
+            f"block_size - max_new_tokens, or use `generate`, whose "
+            f"full-context resampling slides the context window past "
+            f"block_size (the reference's crop semantics)"
+        )
+    cfg = decode_config(config)
     decode_all = _cached_decode_program(
         dataclasses.astuple(cfg), b, t0, max_new_tokens, temperature,
-        top_k,
+        top_k, top_p,
     )
     new = np.asarray(decode_all(params, jnp.asarray(idx),
                                 jax.random.PRNGKey(seed)))
@@ -588,38 +682,31 @@ def generate_fast(params: Any, config: GPTConfig, idx: np.ndarray,
 
 @functools.lru_cache(maxsize=32)
 def _cached_decode_program(cfg_tuple, b, t0, max_new_tokens, temperature,
-                           top_k):
+                           top_k, top_p):
     """Compile the prefill+scan decode program once per (config, shape,
     sampling) signature — a fresh ``jax.jit`` per ``generate_fast`` call
     would recompile every time (~seconds of fixed overhead per call)."""
     cfg = GPTConfig(*cfg_tuple)
     model = GPT(cfg)
 
-    def sample(logits, key):
-        logits = logits.astype(jnp.float32) / temperature
-        if top_k is not None:
-            kk = min(top_k, logits.shape[-1])
-            kth = jax.lax.top_k(logits, kk)[0][..., -1]
-            logits = jnp.where(logits < kth[:, None], -jnp.inf, logits)
-        return jax.random.categorical(key, logits, axis=-1)
-
     @jax.jit
     def decode_all(params, prompt, key):
         logits, varsc = model.apply({"params": params}, prompt,
                                     train=False, mutable=["cache"])
-        keys = jax.random.split(key, max_new_tokens)
-        tok = sample(logits[:, -1], keys[0])
+        tok = sample_logits(logits[:, -1], jax.random.fold_in(key, 0),
+                            temperature, top_k, top_p)
 
-        def body(carry, k):
+        def body(carry, j):
             cache, tok = carry
             lg, vc = model.apply({"params": params, "cache": cache},
                                  tok[:, None], train=False,
                                  mutable=["cache"])
-            nxt = sample(lg[:, -1], k)
+            nxt = sample_logits(lg[:, -1], jax.random.fold_in(key, j),
+                                temperature, top_k, top_p)
             return (vc["cache"], nxt), tok
 
         (_, last), toks = jax.lax.scan(
-            body, (varsc["cache"], tok), keys[1:]
+            body, (varsc["cache"], tok), jnp.arange(1, max_new_tokens)
         )
         toks = jnp.concatenate([toks.T, last[:, None]], axis=1)
         return toks

@@ -1,0 +1,38 @@
+"""gym_tpu.serve — continuous-batching inference over the KV-cache decode
+path (the fifth subsystem, alongside ``data/``, ``strategy/``, ``sim/``
+and ``utils/``).
+
+``generate_fast`` (``models/nanogpt.py``) made single-request decode fast
+but left it fixed-shape (one compile per exact ``(batch, prompt_len,
+max_new_tokens)`` signature) with no request layer. This package is the
+path from a trained ``fit()`` run dir to tokens-per-second under
+concurrent load:
+
+- ``engine``: fixed-capacity slot batch with per-slot ring-position KV
+  caches, ONE jitted decode step shared by every request (per-slot
+  cursors/masks and vectorized per-slot sampling params), and prefill
+  bucketed to powers of two so total compilations are bounded by
+  ``O(log block_size)`` instead of one per prompt length. Requests enter
+  free slots and leave on EOS/max-tokens BETWEEN decode steps —
+  continuous batching, no drain-the-batch barrier.
+- ``scheduler``: FCFS request queue, slot assignment, and a
+  backpressure-bounded submit/poll API.
+- ``load``: params-only checkpoint restore — a ``fit(save_dir=...)`` run
+  dir serves directly, no optimizer-state template needed.
+- ``metrics``: per-request TTFT / per-token latency and engine
+  tokens/s / queue depth / slot occupancy, logged CSVLogger-style to
+  ``serve.csv``.
+- ``__main__``: ``python -m gym_tpu.serve --ckpt <run_dir>`` — a
+  stdlib-HTTP entrypoint with graceful SIGTERM drain.
+"""
+
+from .engine import EngineStats, InferenceEngine, SamplingParams
+from .load import load_for_serving
+from .metrics import ServeMetrics
+from .scheduler import QueueFullError, Request, RequestStatus, Scheduler
+
+__all__ = [
+    "InferenceEngine", "SamplingParams", "EngineStats",
+    "Scheduler", "Request", "RequestStatus", "QueueFullError",
+    "load_for_serving", "ServeMetrics",
+]

@@ -1,0 +1,267 @@
+"""``python -m gym_tpu.serve --ckpt <run_dir>`` — stdlib-HTTP serving.
+
+No framework: ``http.server.ThreadingHTTPServer`` + the scheduler. One
+driver thread runs the engine loop; handler threads submit and block on
+the request future. Endpoints:
+
+- ``POST /generate`` — JSON body with either ``prompt`` (a list of token
+  ids) or ``text`` (char-level corpora only: encoded via the shakespeare
+  ``CHAR_VOCAB``), plus optional ``max_new_tokens`` / ``temperature`` /
+  ``top_k`` / ``top_p`` / ``eos_token`` / ``seed``. Replies with the new
+  ``tokens`` (and ``text`` when the vocab is char-level), TTFT and
+  per-token latency.
+- ``GET /stats`` (alias ``/healthz``) — engine + metrics headline JSON.
+
+Shutdown drill (ISSUE 4 acceptance): SIGTERM/SIGINT triggers a graceful
+drain — stop accepting, FAIL queued requests ("shutting down", reported
+to their waiting handlers, never dropped), ANSWER in-flight requests
+(the engine keeps stepping until the running slots finish, bounded by
+``--drain-deadline``), close the listener, flush ``serve.csv``, print a
+final ``tokens_per_s`` headline, exit 0. A wedged drain dumps every
+thread's stack (``utils.resilience.dump_thread_stacks``) instead of
+hanging silently.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m gym_tpu.serve",
+        description="Serve a trained gym_tpu checkpoint over HTTP "
+                    "(continuous-batching KV-cache decode).")
+    p.add_argument("--ckpt", required=True, metavar="RUN_DIR",
+                   help="checkpoint run dir: fit(save_dir=...)/<run_name>")
+    p.add_argument("--step", type=int, default=None,
+                   help="checkpoint step (default: newest valid)")
+    p.add_argument("--config", default=None, metavar="CONFIG_JSON",
+                   help="explicit config.json (for run dirs predating the "
+                        "in-dir snapshot: logs/<run_name>/config.json)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--num_slots", type=int, default=4,
+                   help="concurrent decode slots (the batch width)")
+    p.add_argument("--max_queue", type=int, default=64,
+                   help="FCFS queue bound (backpressure: submits beyond "
+                        "it wait, then 503)")
+    p.add_argument("--request_timeout", type=float, default=600.0,
+                   help="per-request wall-clock bound inside a handler")
+    p.add_argument("--drain-deadline", type=float, default=300.0,
+                   help="SIGTERM: max seconds to finish in-flight "
+                        "requests before failing them")
+    p.add_argument("--metrics_dir", default=None,
+                   help="serve.csv location (default: <RUN_DIR>/serve)")
+    p.add_argument("--device", default=None,
+                   help="'cpu' pins the CPU backend (skips accelerator "
+                        "plugin init)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.device == "cpu":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    from ..data.build_dataset import CHAR_VOCAB
+    from ..utils.checkpoint import CheckpointNotFoundError
+    from ..utils.resilience import dump_thread_stacks
+    from .engine import InferenceEngine, SamplingParams
+    from .load import load_for_serving
+    from .metrics import ServeMetrics
+    from .scheduler import QueueFullError, Scheduler
+
+    try:
+        params, cfg, info = load_for_serving(
+            args.ckpt, step=args.step, config_path=args.config)
+    except (CheckpointNotFoundError, FileNotFoundError, ValueError) as e:
+        print(f"gym_tpu.serve: cannot load {args.ckpt}: {e}",
+              file=sys.stderr)
+        return 1
+    print(f"gym_tpu.serve: restored step {info['step']} "
+          f"({info['num_nodes']}-node average) from {args.ckpt}",
+          flush=True)
+
+    engine = InferenceEngine(params, cfg, num_slots=args.num_slots)
+    metrics = ServeMetrics(args.metrics_dir
+                           or os.path.join(args.ckpt, "serve"))
+    sched = Scheduler(engine, max_queue=args.max_queue, metrics=metrics)
+    char_level = cfg.vocab_size <= len(CHAR_VOCAB) + 1
+
+    def encode_text(text: str):
+        table = {c: i for i, c in enumerate(CHAR_VOCAB)}
+        toks = [table[c] for c in text if c in table]
+        if not toks:
+            raise ValueError("text encodes to an empty prompt under the "
+                             "char vocab")
+        return np.asarray(toks, np.int32)
+
+    def decode_text(tokens):
+        return "".join(CHAR_VOCAB[t] for t in tokens
+                       if 0 <= t < len(CHAR_VOCAB))
+
+    stop = threading.Event()
+    loop = threading.Thread(target=sched.run, args=(stop,),
+                            name="gym-tpu-serve-loop", daemon=True)
+    loop.start()
+
+    class Handler(BaseHTTPRequestHandler):
+        # quiet structured access log — one line per request on stderr
+        def log_message(self, fmt, *a):
+            sys.stderr.write("gym_tpu.serve: " + fmt % a + "\n")
+
+        def _reply(self, code: int, payload: dict):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path not in ("/stats", "/healthz"):
+                self._reply(404, {"error": f"unknown path {self.path}"})
+                return
+            s = engine.stats
+            self._reply(200, {
+                "status": "draining" if stop.is_set() else "ok",
+                "step": info["step"],
+                "num_slots": s.num_slots,
+                "active_slots": s.active_slots,
+                "queue_depth": sched.queue_depth(),
+                "tokens_generated": s.tokens_generated,
+                "decode_steps": s.decode_steps,
+                "prefills": s.prefills,
+                "prefill_buckets": list(s.prefill_buckets),
+                **metrics.headline(),
+            })
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self._reply(404, {"error": f"unknown path {self.path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                if "prompt" in body:
+                    prompt = np.asarray(body["prompt"], np.int32)
+                elif "text" in body and char_level:
+                    prompt = encode_text(body["text"])
+                elif "text" in body:
+                    raise ValueError(
+                        "text prompts need a char-level vocab; this model "
+                        f"has vocab_size={cfg.vocab_size} — send token "
+                        "ids as 'prompt'")
+                else:
+                    raise ValueError("body needs 'prompt' (token ids) "
+                                     "or 'text'")
+                sp = SamplingParams(
+                    max_new_tokens=int(body.get("max_new_tokens", 64)),
+                    temperature=float(body.get("temperature", 1.0)),
+                    top_k=(None if body.get("top_k") is None
+                           else int(body["top_k"])),
+                    top_p=(None if body.get("top_p") is None
+                           else float(body["top_p"])),
+                    eos_token=(None if body.get("eos_token") is None
+                               else int(body["eos_token"])),
+                    seed=int(body.get("seed", 0)))
+            except (ValueError, KeyError, TypeError,
+                    json.JSONDecodeError) as e:
+                self._reply(400, {"error": str(e)})
+                return
+            try:
+                req = sched.submit(prompt, sp, timeout=30.0)
+            except QueueFullError as e:
+                self._reply(429, {"error": str(e)})
+                return
+            except (RuntimeError, ValueError) as e:
+                # shutting down, or a prompt the KV cache can't fit
+                self._reply(503 if "shutting down" in str(e) else 400,
+                            {"error": str(e)})
+                return
+            try:
+                tokens = req.result(timeout=args.request_timeout)
+            except TimeoutError as e:
+                self._reply(504, {"error": str(e)})
+                return
+            except RuntimeError as e:
+                self._reply(503, {"error": str(e)})
+                return
+            out = {"tokens": tokens,
+                   "prompt_tokens": int(prompt.size),
+                   "ttft_s": round(req.ttft_s, 5),
+                   "latency_s": round(req.done_t - req.submit_t, 5)}
+            if char_level:
+                out["text"] = decode_text(tokens)
+            self._reply(200, out)
+
+    httpd = ThreadingHTTPServer((args.host, args.port), Handler)
+    # answered-before-closed: server_close waits for handler threads, so
+    # every accepted request gets its JSON reply before the process exits
+    httpd.daemon_threads = False
+    httpd.block_on_close = True
+
+    def graceful(signum):
+        name = signal.Signals(signum).name
+        print(f"gym_tpu.serve: {name} — draining "
+              f"(answer in-flight, fail queued)", flush=True)
+        deadline = getattr(args, "drain_deadline")
+        stop.set()               # driver loop exits after its round
+        loop.join(timeout=deadline)
+        if loop.is_alive():
+            # the driver never came back within the drain deadline (a
+            # wedged dispatch, not a slow one): do NOT touch the engine
+            # from this thread — it is single-driver by contract and a
+            # concurrent step() would re-dispatch donated buffers. Dump
+            # the evidence and close the listener; in-flight requests
+            # stay unanswered, which is the truth of a wedged engine.
+            print(dump_thread_stacks(
+                "gym_tpu.serve: driver loop wedged past the "
+                f"{deadline:.0f}s drain deadline:"),
+                file=sys.stderr, flush=True)
+        else:
+            # shutdown() steps the engine itself until running slots
+            # finish — safe now that the driver thread has exited
+            sched.shutdown(finish_running=True, deadline_s=deadline)
+        httpd.shutdown()
+
+    def _on_signal(signum, frame):
+        # serve_forever blocks the main thread; drain from a helper so the
+        # handler returns immediately (a second signal takes default
+        # action — grace, not imprisonment)
+        threading.Thread(target=graceful, args=(signum,),
+                         daemon=True).start()
+        signal.signal(signum, signal.SIG_DFL)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, _on_signal)
+
+    print(f"gym_tpu.serve: listening on http://{args.host}:{args.port} "
+          f"({args.num_slots} slots, queue {args.max_queue})", flush=True)
+    try:
+        httpd.serve_forever()
+    finally:
+        httpd.server_close()
+        metrics.sync()
+        head = metrics.headline()
+        print(f"gym_tpu.serve: shut down cleanly — "
+              f"{head['requests_done']} done, "
+              f"{head['requests_failed']} failed, "
+              f"tokens_per_s={head['tokens_per_s']}", flush=True)
+        metrics.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

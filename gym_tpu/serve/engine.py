@@ -1,0 +1,419 @@
+"""Continuous-batching inference engine: one jitted decode step, N slots.
+
+The design inverts ``generate_fast``'s: instead of one compiled program
+per request signature (prompt length × new tokens × sampling config —
+every new shape recompiles), the engine compiles a FIXED-SHAPE program
+set once and runs every request through it:
+
+- **Decode step** (compiled once per ``(config, num_slots)``): the whole
+  slot batch advances one token. Each slot is an independent sequence at
+  its own cache position — the model's per-row cursors/masks
+  (``models/nanogpt.py:_decode_attend``) keep rows isolated — and the
+  per-slot sampling params (temperature / top-k / top-p / PRNG key) ride
+  in as vectors, applied by a vmapped ``sample_logits``. Inactive slots
+  compute garbage that is never read and their integer cursors are
+  frozen, so a free slot can idle forever without overflowing.
+- **Prefill** (compiled once per power-of-two bucket): a single request's
+  prompt, right-padded to the bucket length, fills a fresh single-row
+  cache and samples the first token at the TRUE last prompt position
+  (padded positions are causally masked away from real queries and
+  overwritten before any later query can attend to them). Total prefill
+  compilations are bounded by ``⌈log2(block_size)⌉ + 1`` — the bucket
+  count — instead of one per distinct prompt length.
+- **Admit/evict** (compiled once): the prefilled row is scattered into
+  the engine cache at the slot index and the slot's cursors rewound to
+  the true prompt length. Admission and eviction happen BETWEEN decode
+  steps (continuous batching): a finished slot frees mid-flight while
+  its neighbors keep decoding — no drain-the-batch barrier.
+
+Parity oracle (tests/test_serve.py): for a single request the engine's
+token stream is IDENTICAL to ``generate_fast`` with the same sampling
+config and seed — both use the shared ``sample_logits`` kernel and the
+``fold_in(PRNGKey(seed), token_index)`` key schedule, and the per-row
+cache math is the same program modulo batch width.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.nanogpt import GPT, GPTConfig, decode_config, sample_logits
+
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling configuration — mirrors ``generate_fast``'s
+    signature so a request and a ``generate_fast`` call are comparable.
+    ``eos_token`` stops the request early (in addition to
+    ``max_new_tokens``); ``None`` disables the check."""
+
+    max_new_tokens: int = 32
+    temperature: float = 1.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    eos_token: Optional[int] = None
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class TokenEvent:
+    """One generated token, as seen by the scheduler."""
+
+    slot: int
+    token: int
+    finished: bool
+
+
+@dataclasses.dataclass
+class EngineStats:
+    tokens_generated: int = 0
+    decode_steps: int = 0
+    prefills: int = 0
+    prefill_compiles: int = 0            # new bucket programs THIS engine hit
+    prefill_buckets: Tuple[int, ...] = ()
+    active_slots: int = 0
+    num_slots: int = 0
+
+
+def prompt_bucket(n: int, block_size: int) -> int:
+    """Power-of-two prefill bucket for an ``n``-token prompt, capped at
+    ``block_size`` — the compile-bound lever: all prompt lengths map to at
+    most ``⌈log2(block_size)⌉ + 1`` distinct shapes."""
+    if n < 1:
+        raise ValueError("empty prompt")
+    b = 1 << (n - 1).bit_length()
+    return min(b, block_size)
+
+
+def max_prefill_buckets(block_size: int) -> int:
+    """The compile-count bound serving any mix of prompt lengths:
+    buckets are {1, 2, 4, ..., 2^⌈log2(block_size)⌉ capped} — at most
+    ``⌈log2(block_size)⌉ + 1`` of them."""
+    return (block_size - 1).bit_length() + 1
+
+
+# Program caches are GLOBAL (keyed by config/shape signature, like
+# models.nanogpt._cached_decode_program) so several engines over the same
+# model — tests, bench arms, server restarts in one process — share
+# compilations. Each engine still counts the buckets it touches for the
+# bounded-compilation observable.
+@functools.lru_cache(maxsize=64)
+def _prefill_program(cfg_tuple, bucket: int):
+    cfg = GPTConfig(*cfg_tuple)
+    model = GPT(cfg)
+
+    @jax.jit
+    def prefill(params, tokens, true_len, key, temp, top_k, top_p):
+        """tokens [1, bucket] right-padded; returns the sampled first
+        token [1] and the filled single-row cache. The first token is
+        sampled INSIDE the program (key schedule index 0) at the true
+        last prompt position, so no per-``true_len`` slicing program
+        exists outside this bucket's compile."""
+        logits, varsc = model.apply({"params": params}, tokens,
+                                    train=False, mutable=["cache"])
+        last = jax.lax.dynamic_index_in_dim(logits, true_len - 1, axis=1,
+                                            keepdims=False)   # [1, V]
+        tok = sample_logits(last, jax.random.fold_in(key, 0),
+                            temp, top_k, top_p)
+        return tok, varsc["cache"]
+
+    return prefill
+
+
+@functools.lru_cache(maxsize=32)
+def _slot_programs(cfg_tuple, num_slots: int, chunk: int):
+    cfg = GPTConfig(*cfg_tuple)
+    model = GPT(cfg)
+
+    # the engine cache is DONATED through both programs: it is multi-MB
+    # (num_slots × block_size × n_embd × 2 × n_layer) and threaded
+    # linearly through the step loop — without donation every dispatch
+    # memcpys the whole thing, which on CPU dominates the step
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def admit(cache, row_cache, slot, true_len):
+        """Scatter a freshly prefilled single-row cache into slot ``slot``
+        and rewind that slot's integer cursors to ``true_len`` (the
+        prefill ran over the PADDED bucket, so its own cursor reads the
+        bucket length; pad K/V beyond ``true_len`` stays in the row but is
+        causally masked until each position is overwritten by decode)."""
+        def leaf(c, n):
+            if c.dtype == jnp.int32:     # per-row cursor ('i'/'pos') leaves
+                return c.at[slot].set(true_len)
+            return c.at[slot].set(n[0])
+
+        return jax.tree.map(leaf, cache, row_cache)
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def decode(params, cache, tok, active, base_keys, gen_idx,
+               remaining, eos, temp, top_k, top_p):
+        """``chunk`` decode steps for the whole slot batch in ONE
+        dispatch (a ``lax.scan``, amortizing per-dispatch overhead the
+        way ``generate_fast``'s whole-request scan does). Each scanned
+        step feeds every slot its current token and samples its next
+        with its own key/params. Slot lifecycle bookkeeping runs ON
+        DEVICE so no host round trip is needed mid-chunk: a slot that
+        hits EOS or exhausts ``remaining`` flips inactive and freezes —
+        its token and integer cursors stop advancing (no cache-overflow
+        creep, no garbage emission; its masked compute is the price of
+        the fixed shape until the next admit).
+
+        Returns ``(toks [chunk, S], emitted [chunk, S], last_logits
+        [S, V], final_tok, final_active, cache)`` — ``emitted`` marks
+        which scanned steps each slot was active for; the host replays
+        it to route tokens to requests."""
+        def body(carry, _):
+            cache, tok, act, gidx, rem, _lg = carry
+            logits, varsc = model.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                train=False, mutable=["cache"])
+            lg = logits[:, 0]                               # [S, V]
+            keys = jax.vmap(jax.random.fold_in)(base_keys, gidx)
+            nxt = jax.vmap(sample_logits)(lg, keys, temp, top_k, top_p)
+            nxt = jnp.where(act, nxt, tok).astype(jnp.int32)
+            new_cache = jax.tree.map(
+                lambda n, o: jnp.where(act, n, o)
+                if n.dtype == jnp.int32 else n,
+                varsc["cache"], cache)
+            emitted = act
+            gidx = jnp.where(act, gidx + 1, gidx)
+            rem = jnp.where(act, rem - 1, rem)
+            done = act & ((rem <= 0) | ((eos >= 0) & (nxt == eos)))
+            # last step's logits ride in the CARRY (teacher-forcing /
+            # debug observable) — stacking [chunk, S, V] would move the
+            # whole vocab per scanned step at GPT-2 vocab sizes
+            return ((new_cache, nxt, act & ~done, gidx, rem, lg),
+                    (nxt, emitted))
+
+        lg0 = jnp.zeros((num_slots, cfg.vocab_size), jnp.float32)
+        (cache, tok, active, gen_idx, remaining, lg), (toks, emitted) = \
+            jax.lax.scan(body,
+                         (cache, tok, active, gen_idx, remaining, lg0),
+                         None, length=chunk)
+        return toks, emitted, lg, tok, active, cache
+
+    return admit, decode
+
+
+class InferenceEngine:
+    """Slot-level mechanics: caches, prefill, the shared decode step.
+
+    Request-level concerns (queueing, backpressure, completion futures)
+    live in ``scheduler.Scheduler``; the engine only knows slots. Not
+    thread-safe — one driver thread calls ``admit``/``step``/``release``
+    (the scheduler serializes access).
+    """
+
+    def __init__(self, params: PyTree, config: GPTConfig,
+                 num_slots: int = 8, decode_chunk: int = 1):
+        """``decode_chunk``: decode steps fused into one dispatch (a
+        device-side scan with on-device EOS/max-token bookkeeping).
+        1 = purest continuous batching — admission/eviction can happen
+        after every token. Larger chunks amortize per-dispatch overhead
+        (the lever that beats ``generate_fast``'s whole-request scan on
+        throughput) at the cost of slot-turnaround latency: a slot
+        finishing mid-chunk frees only at the chunk boundary."""
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if decode_chunk < 1:
+            raise ValueError(
+                f"decode_chunk must be >= 1, got {decode_chunk}")
+        self.config = decode_config(config)
+        self.block_size = int(config.block_size)
+        self.num_slots = int(num_slots)
+        self.decode_chunk = int(decode_chunk)
+        self.params = jax.tree.map(jnp.asarray, params)
+        self._cfg_tuple = dataclasses.astuple(self.config)
+        self._admit_prog, self._decode_prog = _slot_programs(
+            self._cfg_tuple, self.num_slots, self.decode_chunk)
+        self._step1_prog = None          # lazy chunk-1 twin (teacher forcing)
+        self._seen_buckets: set = set()
+        self._cache = self._init_cache()
+        s = self.num_slots
+        self._active = np.zeros(s, bool)
+        self._next_tok = np.zeros(s, np.int32)     # input token per slot
+        self._gen_idx = np.zeros(s, np.int32)      # key-schedule index
+        self._generated = np.zeros(s, np.int64)    # tokens emitted so far
+        self._max_new = np.zeros(s, np.int64)
+        self._eos = np.full(s, -1, np.int64)       # -1 = disabled
+        self._temp = np.ones(s, np.float32)
+        self._top_k = np.full(s, self.config.vocab_size, np.int32)
+        self._top_p = np.ones(s, np.float32)
+        self._base_keys = np.zeros((s, 2), np.uint32)
+        self.stats = EngineStats(num_slots=s)
+        self.last_logits: Optional[np.ndarray] = None  # [S, V] post-step
+
+    def _init_cache(self) -> PyTree:
+        model = GPT(self.config)
+        dummy = jnp.zeros((self.num_slots, 1), jnp.int32)
+        shapes = jax.eval_shape(
+            lambda: model.init({"params": jax.random.PRNGKey(0)}, dummy,
+                               train=False))
+        return jax.tree.map(lambda sh: jnp.zeros(sh.shape, sh.dtype),
+                            shapes["cache"])
+
+    # -- slot lifecycle ---------------------------------------------------
+
+    def free_slots(self) -> List[int]:
+        return [i for i in range(self.num_slots) if not self._active[i]]
+
+    def validate(self, prompt: np.ndarray, sp: SamplingParams) -> None:
+        """Typed rejection of requests the decode path cannot serve
+        honestly — callers (scheduler.submit, the HTTP handler) fail fast
+        with a ValueError instead of poisoning a slot: cache overflow
+        (the same error ``generate_fast`` raises), out-of-vocab token ids
+        (XLA's gather would silently CLAMP them to vocab_size-1 and serve
+        a completion for a prompt the client never sent), and
+        non-positive temperature (logits/0 → NaN → garbage tokens;
+        greedy decoding is ``top_k=1``, not ``temperature=0``)."""
+        prompt = np.asarray(prompt)
+        n = int(prompt.size)
+        if n < 1:
+            raise ValueError("empty prompt")
+        if prompt.min() < 0 or prompt.max() >= self.config.vocab_size:
+            raise ValueError(
+                f"prompt token ids must be in [0, {self.config.vocab_size})"
+                f"; got range [{int(prompt.min())}, {int(prompt.max())}]")
+        if sp.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {sp.max_new_tokens}")
+        if not sp.temperature > 0:
+            raise ValueError(
+                f"temperature must be > 0 (got {sp.temperature}); use "
+                f"top_k=1 for greedy decoding")
+        if n + sp.max_new_tokens > self.block_size:
+            raise ValueError(
+                f"prompt {n} + {sp.max_new_tokens} new tokens exceeds the "
+                f"KV cache (block_size {self.block_size}); crop the prompt "
+                f"to block_size - max_new_tokens, or use `generate`, whose "
+                f"full-context resampling slides the context window")
+
+    def admit(self, prompt: np.ndarray,
+              sp: SamplingParams) -> Tuple[int, TokenEvent]:
+        """Prefill ``prompt`` into a free slot and sample its first token.
+        Returns ``(slot, event)``; when the first token already finishes
+        the request (``max_new_tokens == 1`` or instant EOS) the slot is
+        released before returning."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.validate(prompt, sp)
+        free = self.free_slots()
+        if not free:
+            raise RuntimeError("no free slot — admit() requires one "
+                               "(scheduler bug: check free_slots() first)")
+        slot = free[0]
+        n = len(prompt)
+        bucket = prompt_bucket(n, self.block_size)
+        self._seen_buckets.add(bucket)
+        # count true program-cache misses: the compile-bound observable is
+        # XLA compilations, and a program another engine over the same
+        # config already compiled is a hit, not a compile
+        before = _prefill_program.cache_info().misses
+        prefill = _prefill_program(self._cfg_tuple, bucket)
+        if _prefill_program.cache_info().misses > before:
+            self.stats.prefill_compiles += 1
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = prompt
+        base_key = np.asarray(jax.random.PRNGKey(sp.seed), np.uint32)
+        top_k = (self.config.vocab_size if sp.top_k is None
+                 else int(sp.top_k))
+        top_p = 1.0 if sp.top_p is None else float(sp.top_p)
+        tok, row_cache = prefill(
+            self.params, jnp.asarray(padded), np.int32(n),
+            jnp.asarray(base_key), np.float32(sp.temperature),
+            np.int32(top_k), np.float32(top_p))
+        self._cache = self._admit_prog(self._cache, row_cache,
+                                       np.int32(slot), np.int32(n))
+        first = int(np.asarray(tok)[0])
+        self.stats.prefills += 1
+        self.stats.tokens_generated += 1
+        # slot bookkeeping: the first token came from the prefill (key
+        # index 0); decode steps continue the schedule at index 1
+        self._active[slot] = True
+        self._next_tok[slot] = first
+        self._gen_idx[slot] = 1
+        self._generated[slot] = 1
+        self._max_new[slot] = sp.max_new_tokens
+        self._eos[slot] = -1 if sp.eos_token is None else int(sp.eos_token)
+        self._temp[slot] = sp.temperature
+        self._top_k[slot] = top_k
+        self._top_p[slot] = top_p
+        self._base_keys[slot] = base_key
+        finished = (sp.max_new_tokens <= 1
+                    or (sp.eos_token is not None and first == sp.eos_token))
+        if finished:
+            self._active[slot] = False
+        self.stats.active_slots = int(self._active.sum())
+        self.stats.prefill_buckets = tuple(sorted(self._seen_buckets))
+        return slot, TokenEvent(slot, first, finished)
+
+    def release(self, slot: int) -> None:
+        """Free a slot between decode steps (EOS/max-tokens eviction or a
+        cancelled request). The cache rows stay as-is — the next admit
+        overwrites them wholesale."""
+        self._active[slot] = False
+        self.stats.active_slots = int(self._active.sum())
+
+    def step(self, override_tokens: Optional[Dict[int, int]] = None
+             ) -> List[TokenEvent]:
+        """Advance every active slot by up to ``decode_chunk`` tokens (one
+        dispatch); returns the new tokens in generation order. Slots that
+        finish (EOS / max-tokens, decided ON DEVICE mid-chunk) come back
+        inactive and are free for the next admit — eviction happens
+        between dispatches, admission too: continuous batching at chunk
+        granularity.
+
+        ``override_tokens`` (teacher forcing, tests/eval only) replaces a
+        slot's INPUT token for ONE single step — the call runs a chunk-1
+        program regardless of ``decode_chunk`` and the returned logits
+        (``self.last_logits``) are the model's prediction conditioned on
+        the forced history, while sampling proceeds normally.
+        """
+        prog = self._decode_prog
+        if override_tokens:
+            for slot, tok in override_tokens.items():
+                self._next_tok[slot] = int(tok)
+            if self.decode_chunk != 1:
+                if self._step1_prog is None:
+                    _, self._step1_prog = _slot_programs(
+                        self._cfg_tuple, self.num_slots, 1)
+                prog = self._step1_prog
+        if not self._active.any():
+            return []
+        was_active = self._active.copy()
+        remaining = (self._max_new - self._generated).astype(np.int32)
+        toks, emitted, lg, final_tok, final_active, cache = prog(
+            self.params, self._cache, jnp.asarray(self._next_tok),
+            jnp.asarray(self._active), jnp.asarray(self._base_keys),
+            jnp.asarray(self._gen_idx), jnp.asarray(remaining),
+            jnp.asarray(self._eos.astype(np.int32)),
+            jnp.asarray(self._temp), jnp.asarray(self._top_k),
+            jnp.asarray(self._top_p))
+        self._cache = cache
+        toks = np.asarray(toks)                    # [chunk, S]
+        emitted = np.asarray(emitted)              # [chunk, S] bool
+        self.last_logits = np.asarray(lg)
+        self._next_tok = np.asarray(final_tok).astype(np.int32).copy()
+        self._active = np.asarray(final_active).copy()
+        events: List[TokenEvent] = []
+        n_steps = toks.shape[0]
+        for k in range(n_steps):
+            for slot in np.nonzero(emitted[k])[0]:
+                tok = int(toks[k, slot])
+                self._gen_idx[slot] += 1
+                self._generated[slot] += 1
+                # finished iff the device stopped emitting for this slot
+                # (its last emitted step) and it came back inactive
+                last_emit = not emitted[k + 1:, slot].any()
+                finished = bool(last_emit and not self._active[slot])
+                events.append(TokenEvent(int(slot), tok, finished))
+        self.stats.tokens_generated += len(events)
+        self.stats.decode_steps += int(was_active.any()) * n_steps
+        self.stats.active_slots = int(self._active.sum())
+        return events

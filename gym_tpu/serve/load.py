@@ -1,0 +1,105 @@
+"""Params-only checkpoint → serveable (params, GPTConfig).
+
+A training run dir (``fit(save_dir=..., checkpoint_interval=...)``) holds
+step-numbered Orbax checkpoints of the FULL train state — per-node
+params, optimizer state, strategy state — plus, since the serve
+subsystem landed, a ``config.json`` snapshot written next to the step
+dirs (``trainer.py``). Serving needs none of the training machinery:
+
+1. ``utils.checkpoint.restore_params`` reads the newest valid step
+   template-free and hands back the node-stacked ``params`` tree.
+2. The [K] node axis is averaged away — the same node-averaged model a
+   ``FitResult.params`` returns (the reference averages final state
+   dicts across ranks).
+3. ``GPTConfig`` is rebuilt from ``config.json``'s ``model_config`` and
+   sanitized for decode by the engine (``models.nanogpt.decode_config``)
+   — sharding axes and the pinned MoE dispatch are training-time
+   concerns.
+
+``CheckpointNotFoundError`` propagates typed (CLIs surface it as a
+one-line message, not a traceback).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.nanogpt import GPTConfig
+from ..utils.checkpoint import CheckpointNotFoundError, restore_params
+
+PyTree = Any
+
+
+def read_run_config(run_dir: str,
+                    config_path: Optional[str] = None) -> Dict[str, Any]:
+    """Load the run's captured ``config.json``. Looked up in the run dir
+    itself (where the trainer writes it next to the step dirs); an
+    explicit ``config_path`` overrides — e.g. for run dirs from before
+    the snapshot existed, point at ``logs/<run_name>/config.json``."""
+    path = config_path or os.path.join(run_dir, "config.json")
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"no config.json at {path} — pass config_path= (the CSVLogger "
+            f"copy under logs/<run_name>/ works) or an explicit GPTConfig")
+    with open(path) as f:
+        return json.load(f)
+
+
+def gpt_config_from_run(config: Dict[str, Any]) -> GPTConfig:
+    """Rebuild the ``GPTConfig`` from a captured run config
+    (``trainer._model_config`` flattens the module's nested ``config``
+    dataclass into ``model_config.config``). Unknown keys are ignored so
+    an older server binary can read a newer run's snapshot."""
+    model_cfg = (config.get("model_config") or {}).get("config")
+    if not isinstance(model_cfg, dict):
+        raise ValueError(
+            "config.json carries no model_config.config — was this run's "
+            "model a GPT? (serving currently supports the GPT family)")
+    fields = {f.name for f in dataclasses.fields(GPTConfig)}
+    return GPTConfig(**{k: v for k, v in model_cfg.items() if k in fields})
+
+
+def load_for_serving(run_dir: str, step: Optional[int] = None,
+                     config: Optional[GPTConfig] = None,
+                     config_path: Optional[str] = None
+                     ) -> Tuple[PyTree, GPTConfig, Dict[str, Any]]:
+    """Restore a ``fit()`` run dir for inference.
+
+    Returns ``(params, config, info)``: the node-AVERAGED f32 param tree
+    (device arrays), the run's ``GPTConfig`` (training sharding intact —
+    the engine sanitizes via ``decode_config``), and an info dict
+    (``step``, ``num_nodes``, the raw run config). ``config=`` skips the
+    ``config.json`` lookup entirely (e.g. serving hand-built params).
+    """
+    if not os.path.isdir(run_dir):
+        raise CheckpointNotFoundError(
+            f"checkpoint run dir {run_dir} does not exist")
+    raw: Dict[str, Any] = {}
+    if config is None:
+        raw = read_run_config(run_dir, config_path)
+        config = gpt_config_from_run(raw)
+    at_step, node_params, _extra = restore_params(run_dir, step=step)
+    leaves = jax.tree.leaves(node_params)
+    if not leaves:
+        raise CheckpointNotFoundError(
+            f"checkpoint step {at_step} under {run_dir} restored an "
+            f"empty params tree")
+    k = int(leaves[0].shape[0])
+    want_k = raw.get("num_nodes")
+    if want_k is not None and int(want_k) != k:
+        raise ValueError(
+            f"checkpoint params carry a [{k}]-node axis but config.json "
+            f"says num_nodes={want_k} — wrong run dir / config pairing?")
+    # node-average on device (the FitResult.params convention); params
+    # are float, so a plain mean is exact in intent and f32 in practice
+    avg = jax.jit(
+        lambda t: jax.tree.map(lambda x: jnp.mean(x, axis=0), t)
+    )(node_params)
+    info = {"step": at_step, "num_nodes": k, "run_config": raw}
+    return avg, config, info

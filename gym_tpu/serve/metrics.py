@@ -1,0 +1,126 @@
+"""Serving observability: ``serve.csv`` + aggregate headline.
+
+CSVLogger-style (``utils/logger.py``): one append-only CSV under the
+serve log dir, fsync on ``sync()``, atomic enough for a tail -f. Two row
+kinds share the header:
+
+- ``request`` — one row per completed/failed request: TTFT, new-token
+  count, mean per-token latency, and the queue/slot state at completion.
+- ``engine``  — a periodic engine sample (every ``engine_log_every``
+  ticks of the driver loop): cumulative tokens, rolling tokens/s, queue
+  depth, active-slot occupancy.
+
+``headline()`` aggregates the run into the one-line JSON surface
+``bench.py --serve-only`` and the HTTP ``/stats`` endpoint report.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import threading
+import time
+from typing import Any, Dict
+
+HEADER = [
+    "ts_s", "kind", "request_id", "status", "queue_depth", "active_slots",
+    "prompt_tokens", "new_tokens", "ttft_s", "avg_token_latency_s",
+    "cum_tokens", "tokens_per_s",
+]
+
+
+class ServeMetrics:
+    def __init__(self, out_dir: str, engine_log_every: int = 50):
+        os.makedirs(out_dir, exist_ok=True)
+        self.path = os.path.join(out_dir, "serve.csv")
+        # append, not "w": a server restart over the same run dir must
+        # not destroy the previous run's request history — the header is
+        # written only when the file is new/empty
+        new_file = (not os.path.exists(self.path)
+                    or os.path.getsize(self.path) == 0)
+        self._f = open(self.path, "a", newline="")
+        self._w = csv.writer(self._f)
+        if new_file:
+            self._w.writerow(HEADER)
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        self._every = max(1, int(engine_log_every))
+        self._ticks = 0
+        self.requests_done = 0
+        self.requests_failed = 0
+        self.tokens_out = 0
+        self._ttft_sum = 0.0
+        self._ttft_n = 0
+        self._lat_sum = 0.0
+        self._lat_n = 0
+
+    def _now(self) -> float:
+        return time.perf_counter() - self._t0
+
+    def request_done(self, req, queue_depth: int,
+                     active_slots: int) -> None:
+        with self._lock:
+            failed = req.error is not None
+            self.requests_failed += int(failed)
+            self.requests_done += int(not failed)
+            self.tokens_out += len(req.tokens)
+            ttft = req.ttft_s
+            lat = req.avg_token_latency_s
+            if ttft is not None:
+                self._ttft_sum += ttft
+                self._ttft_n += 1
+            if lat is not None:
+                self._lat_sum += lat
+                self._lat_n += 1
+            self._w.writerow([
+                f"{self._now():.4f}", "request", req.id,
+                "failed" if failed else "done", queue_depth, active_slots,
+                int(req.prompt.size), len(req.tokens),
+                "" if ttft is None else f"{ttft:.5f}",
+                "" if lat is None else f"{lat:.5f}",
+                self.tokens_out, f"{self.tokens_per_s():.2f}",
+            ])
+            self._f.flush()
+
+    def engine_tick(self, stats, queue_depth: int) -> None:
+        """Sampled engine row — call once per driver-loop round; writes
+        every ``engine_log_every``-th call so an idle server doesn't grow
+        the CSV unboundedly."""
+        with self._lock:
+            self._ticks += 1
+            if self._ticks % self._every:
+                return
+            self._w.writerow([
+                f"{self._now():.4f}", "engine", "", "", queue_depth,
+                stats.active_slots, "", "", "", "",
+                stats.tokens_generated, f"{self.tokens_per_s():.2f}",
+            ])
+
+    def tokens_per_s(self) -> float:
+        dt = self._now()
+        return self.tokens_out / dt if dt > 0 else 0.0
+
+    def headline(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "requests_done": self.requests_done,
+                "requests_failed": self.requests_failed,
+                "tokens_out": self.tokens_out,
+                "wall_s": round(self._now(), 3),
+                "tokens_per_s": round(self.tokens_per_s(), 2),
+                "mean_ttft_s": (round(self._ttft_sum / self._ttft_n, 5)
+                                if self._ttft_n else None),
+                "mean_token_latency_s": (
+                    round(self._lat_sum / self._lat_n, 5)
+                    if self._lat_n else None),
+            }
+
+    def sync(self) -> None:
+        with self._lock:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        with self._lock:
+            self._f.flush()
+            self._f.close()

@@ -1,0 +1,240 @@
+"""FCFS request scheduler over the slot engine.
+
+The engine (``engine.py``) knows slots; this layer knows REQUESTS:
+
+- ``submit``: thread-safe, backpressure-bounded — when the FCFS queue is
+  full it blocks up to ``timeout`` for a drain (or raises
+  ``QueueFullError`` immediately with ``block=False``). Requests that
+  can never fit the KV cache are rejected at submit time with the same
+  typed ``ValueError`` ``generate_fast`` raises.
+- ``step``: one scheduling round, run by the single driver thread:
+  admit queued requests into free slots (prefill), advance every active
+  slot one token (the shared decode step), and complete/evict finished
+  requests BETWEEN steps — continuous batching.
+- ``Request``: the poll/wait surface — status, accumulated tokens, and a
+  ``result(timeout)`` future; per-request TTFT/latency timestamps feed
+  ``metrics.ServeMetrics``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .engine import InferenceEngine, SamplingParams
+
+
+class QueueFullError(RuntimeError):
+    """Backpressure signal: the FCFS queue is at capacity and the caller
+    declined (or timed out) waiting for it to drain."""
+
+
+class RequestStatus(enum.Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    DONE = "done"
+    FAILED = "failed"
+
+
+@dataclasses.dataclass
+class Request:
+    """A submitted generation request. ``tokens`` accumulates NEW tokens
+    (the prompt is not echoed); timestamps are ``time.perf_counter()``."""
+
+    id: int
+    prompt: np.ndarray
+    sampling: SamplingParams
+    status: RequestStatus = RequestStatus.QUEUED
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    error: Optional[str] = None
+    submit_t: float = 0.0
+    first_token_t: Optional[float] = None
+    done_t: Optional[float] = None
+    _event: threading.Event = dataclasses.field(
+        default_factory=threading.Event, repr=False)
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until the request completes; returns the new tokens or
+        raises ``RuntimeError`` (failed) / ``TimeoutError``."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"request {self.id} still "
+                               f"{self.status.value} after {timeout}s")
+        if self.status is RequestStatus.FAILED:
+            raise RuntimeError(f"request {self.id} failed: {self.error}")
+        return list(self.tokens)
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_t is None:
+            return None
+        return self.first_token_t - self.submit_t
+
+    @property
+    def avg_token_latency_s(self) -> Optional[float]:
+        """Mean inter-token latency AFTER the first token (TTFT is its
+        own observable)."""
+        if (self.done_t is None or self.first_token_t is None
+                or len(self.tokens) < 2):
+            return None
+        return (self.done_t - self.first_token_t) / (len(self.tokens) - 1)
+
+
+class Scheduler:
+    """FCFS queue + slot assignment. One driver thread calls ``step``
+    (or ``run``); any number of threads call ``submit``."""
+
+    def __init__(self, engine: InferenceEngine, max_queue: int = 64,
+                 metrics=None):
+        self.engine = engine
+        self.max_queue = int(max_queue)
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._drained = threading.Condition(self._lock)
+        self._queue: deque = deque()
+        self._by_slot: Dict[int, Request] = {}
+        self._ids = itertools.count()
+        self._accepting = True
+
+    # -- submit side ------------------------------------------------------
+
+    def submit(self, prompt, sampling: Optional[SamplingParams] = None,
+               block: bool = True,
+               timeout: Optional[float] = 30.0) -> Request:
+        sampling = sampling or SamplingParams()
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.engine.validate(prompt, sampling)   # typed ValueError, early
+        deadline = None if timeout is None else time.perf_counter() + timeout
+        with self._drained:
+            if not self._accepting:
+                raise RuntimeError("scheduler is shutting down")
+            while len(self._queue) >= self.max_queue:
+                if not block:
+                    raise QueueFullError(
+                        f"request queue at capacity ({self.max_queue})")
+                rem = None if deadline is None \
+                    else deadline - time.perf_counter()
+                if rem is not None and rem <= 0:
+                    raise QueueFullError(
+                        f"request queue still at capacity "
+                        f"({self.max_queue}) after {timeout}s")
+                self._drained.wait(rem)
+                if not self._accepting:
+                    raise RuntimeError("scheduler is shutting down")
+            req = Request(id=next(self._ids), prompt=prompt,
+                          sampling=sampling, submit_t=time.perf_counter())
+            self._queue.append(req)
+        return req
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def active_requests(self) -> int:
+        with self._lock:
+            return len(self._by_slot)
+
+    # -- driver side ------------------------------------------------------
+
+    def _admit_from_queue(self) -> int:
+        admitted = 0
+        while self.engine.free_slots():
+            with self._drained:
+                if not self._queue:
+                    break
+                req = self._queue.popleft()
+                self._drained.notify_all()
+            try:
+                slot, ev = self.engine.admit(req.prompt, req.sampling)
+            except Exception as e:  # noqa: BLE001 — a bad request must
+                # fail ITSELF, not tear the serving loop down
+                self._fail(req, f"{type(e).__name__}: {e}")
+                continue
+            req.status = RequestStatus.RUNNING
+            req.first_token_t = time.perf_counter()
+            req.tokens.append(ev.token)
+            admitted += 1
+            if ev.finished:
+                self._complete(req)
+            else:
+                self._by_slot[slot] = req
+        return admitted
+
+    def step(self) -> int:
+        """One scheduling round; returns the number of tokens produced
+        (0 = idle). Admission happens BEFORE the decode step so a freed
+        slot turns around within one round."""
+        produced = self._admit_from_queue()
+        events = self.engine.step()
+        now = time.perf_counter()
+        for ev in events:
+            req = self._by_slot.get(ev.slot)
+            if req is None:      # slot freed by a cancel between steps
+                continue
+            req.tokens.append(ev.token)
+            produced += 1
+            if ev.finished:
+                del self._by_slot[ev.slot]
+                self._complete(req, now)
+        return produced
+
+    def _complete(self, req: Request,
+                  now: Optional[float] = None) -> None:
+        req.done_t = now if now is not None else time.perf_counter()
+        req.status = RequestStatus.DONE
+        req._event.set()
+        if self.metrics is not None:
+            self.metrics.request_done(
+                req, queue_depth=self.queue_depth(),
+                active_slots=self.engine.stats.active_slots)
+
+    def _fail(self, req: Request, error: str) -> None:
+        req.error = error
+        req.status = RequestStatus.FAILED
+        req.done_t = time.perf_counter()
+        req._event.set()
+        if self.metrics is not None:
+            self.metrics.request_done(
+                req, queue_depth=self.queue_depth(),
+                active_slots=self.engine.stats.active_slots)
+
+    def run(self, stop: threading.Event, idle_wait_s: float = 0.005):
+        """Drive ``step`` until ``stop`` is set; sleeps briefly when idle
+        (no busy spin — submissions are picked up at the next round)."""
+        while not stop.is_set():
+            produced = self.step()
+            if self.metrics is not None:
+                self.metrics.engine_tick(
+                    self.engine.stats, queue_depth=self.queue_depth())
+            if produced == 0:
+                stop.wait(idle_wait_s)
+
+    def shutdown(self, finish_running: bool = True,
+                 deadline_s: float = 300.0) -> None:
+        """Graceful drain (the SIGTERM path): stop accepting, FAIL queued
+        requests ("shutting down" — reported, not dropped), and either
+        answer every in-flight request (``finish_running=True``, bounded
+        by ``deadline_s``) or fail those too. Call from the driver thread
+        or after the driver loop has stopped."""
+        with self._drained:
+            self._accepting = False
+            queued = list(self._queue)
+            self._queue.clear()
+            self._drained.notify_all()
+        for req in queued:
+            self._fail(req, "server shutting down before this request "
+                            "was scheduled")
+        if finish_running:
+            deadline = time.perf_counter() + deadline_s
+            while self._by_slot and time.perf_counter() < deadline:
+                self.step()
+        for slot, req in list(self._by_slot.items()):
+            self.engine.release(slot)
+            del self._by_slot[slot]
+            self._fail(req, "server shut down mid-generation")

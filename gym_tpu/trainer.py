@@ -652,6 +652,16 @@ class Trainer:
                if net_sim is not None else {}),
             **strategy.config(),
         }
+        if ckpt is not None and primary:
+            # snapshot the run config NEXT TO the step dirs (the CSVLogger
+            # copy lives under log_dir, which serving has no way to find):
+            # gym_tpu.serve's params-only restore rebuilds the model from
+            # this, so a fit() run dir serves directly
+            import json
+            from .utils.logger import _jsonable
+            with open(os.path.join(ckpt.directory, "config.json"),
+                      "w") as f:
+                json.dump(_jsonable(config), f, indent=2, default=str)
 
         if not primary:
             # non-primary hosts: no files, no bars, no duplicate events

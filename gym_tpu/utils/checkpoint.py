@@ -62,6 +62,85 @@ class CheckpointNotFoundError(RuntimeError):
     has no committed steps, or every committed step is corrupt."""
 
 
+def restore_params(run_dir: str, step: Optional[int] = None,
+                   retry_policy: Optional[RetryPolicy] = None
+                   ) -> Tuple[int, PyTree, dict]:
+    """Params-only restore from a checkpoint run dir
+    (``<save_dir>/<run_name>``) — no train-state template required.
+
+    ``CheckpointManager.restore`` needs the full ``TrainState`` template
+    (including optimizer state) to describe shapes/shardings to Orbax; a
+    serving process (``gym_tpu/serve``) has no strategy to build one
+    from. This walks the committed steps NEWEST-FIRST (or takes the
+    pinned ``step``), reads each with Orbax's template-free restore (the
+    tree comes back exactly as saved), and returns
+    ``(step, state['params'], extra_meta)`` — the per-node-stacked param
+    tree with its leading [K] node axis intact (callers average it;
+    ``serve.load`` does).
+
+    Read-only by design: unreadable steps are SKIPPED, never quarantined
+    or deleted — a serving process must not mutate a run dir a trainer
+    may still own. Transient IO errors are retried (``retry_policy``,
+    default ``RetryPolicy.from_env()``) before a step is skipped.
+    Raises ``CheckpointNotFoundError`` when no (valid) step exists, or
+    when a pinned ``step`` is absent.
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(run_dir)
+    if not os.path.isdir(path):
+        raise CheckpointNotFoundError(
+            f"checkpoint run dir {path} does not exist")
+    retry = retry_policy or RetryPolicy.from_env()
+    mgr = ocp.CheckpointManager(
+        path, options=ocp.CheckpointManagerOptions(
+            create=False, read_only=True))
+    try:
+        steps = sorted(mgr.all_steps(), reverse=True)
+        if step is not None:
+            if step not in steps:
+                raise CheckpointNotFoundError(
+                    f"checkpoint step {step} not found under {path} "
+                    f"(have {sorted(steps)})")
+            steps = [step]
+        if not steps:
+            raise CheckpointNotFoundError(
+                f"no checkpoint to restore under {path}")
+
+        def read(s):
+            restored = mgr.restore(
+                s, args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(),
+                    meta=ocp.args.JsonRestore()))
+            state = restored["state"]
+            if "params" not in state:
+                raise ValueError(
+                    f"checkpoint step {s} has no 'params' subtree "
+                    f"(keys: {sorted(state)})")
+            meta = restored["meta"] or {}
+            return int(s), state["params"], dict(meta.get("extra", {}))
+
+        errors = []
+        for s in steps:
+            try:
+                return with_retries(
+                    lambda s=s: read(s), retry,
+                    describe=f"params-only restore (step {s})")
+            except Exception as e:  # noqa: BLE001 — corrupt-step skip
+                errors.append((s, e))
+                import sys
+                sys.stderr.write(
+                    f"gym_tpu: skipping unreadable checkpoint step {s} "
+                    f"under {path} ({type(e).__name__}: {e})\n")
+        raise CheckpointNotFoundError(
+            f"no valid checkpoint under {path}: every step in {steps} "
+            f"failed to restore "
+            f"(newest: {type(errors[0][1]).__name__}: {errors[0][1]})"
+        ) from errors[0][1]
+    finally:
+        mgr.close()
+
+
 class CheckpointManager:
     """Orbax-backed manager for a training run.
 

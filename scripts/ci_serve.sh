@@ -1,0 +1,112 @@
+#!/usr/bin/env bash
+# Serving gate (ISSUE 4) — the serve/decode unit suites plus one CLI
+# smoke run through the real HTTP entry point, run NEXT TO
+# scripts/ci_tier1.sh, ci_faults.sh and ci_sim.sh. The unit suites pin
+# the engine-vs-generate_fast parity oracle, teacher-forcing logits,
+# bounded prefill compilation and the params-only restore; the smoke run
+# proves `python -m gym_tpu.serve` end to end: train a tiny checkpoint,
+# serve it, answer 4 CONCURRENT requests, then the SIGTERM drill — the
+# server must exit rc=0 with a clean-shutdown line and a tokens_per_s
+# headline. CPU-only; sized for the 2-core container.
+#
+# Usage: scripts/ci_serve.sh   (from the repo root or anywhere)
+set -o pipefail
+cd "$(dirname "$0")/.."
+REPO="$(pwd)"
+
+rm -f /tmp/_serve.log
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_serve.py tests/test_decode.py -q -m 'not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_serve.log
+rc=${PIPESTATUS[0]}
+echo SERVE_DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' \
+    /tmp/_serve.log | tr -cd . | wc -c)
+[ "$rc" -ne 0 ] && exit "$rc"
+
+# CLI smoke: tiny checkpoint -> HTTP server -> 4 concurrent requests ->
+# SIGTERM drill. Fresh dir per run.
+OUT=${GYM_TPU_CI_SERVE_OUT:-/tmp/gym_tpu_ci_serve}
+PORT=${GYM_TPU_CI_SERVE_PORT:-8741}
+rm -rf "$OUT"; mkdir -p "$OUT"
+
+timeout -k 10 300 env JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python - "$OUT" <<'EOF'
+import sys, numpy as np
+from gym_tpu import Trainer
+from gym_tpu.data import ArrayDataset
+from gym_tpu.models.nanogpt import GPT, GPTConfig
+from gym_tpu.strategy.optim import OptimSpec
+from gym_tpu.strategy.simple_reduce import SimpleReduceStrategy
+
+out = sys.argv[1]
+cfg = GPTConfig(block_size=32, vocab_size=48, n_layer=2, n_head=2,
+                n_embd=32, dropout=0.0)
+rng = np.random.default_rng(0)
+toks = rng.integers(0, 48, (64, 33))
+ds = ArrayDataset(toks[:, :-1].astype(np.int64),
+                  toks[:, 1:].astype(np.int64))
+Trainer(GPT(cfg), ds).fit(
+    strategy=SimpleReduceStrategy(optim_spec=OptimSpec("adamw", lr=1e-3)),
+    num_nodes=1, max_steps=4, batch_size=4, val_size=0, val_interval=0,
+    show_progress=False, seed=1, checkpoint_interval=4,
+    save_dir=out + "/ckpts", run_name="ci", log_dir=out + "/logs")
+print("ci_serve: checkpoint trained")
+EOF
+rc=$?
+[ "$rc" -ne 0 ] && { echo "ci_serve: training the smoke ckpt failed"; exit "$rc"; }
+
+# bare `python ... &` so $! is the server pid, not a subshell's
+env JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python -m gym_tpu.serve \
+    --ckpt "$OUT/ckpts/ci" --port "$PORT" --num_slots 2 --device cpu \
+    > "$OUT/server.log" 2>&1 &
+SRV=$!
+for _ in $(seq 1 90); do
+    grep -q "listening" "$OUT/server.log" && break
+    kill -0 "$SRV" 2>/dev/null || { echo "ci_serve: server died at startup";
+        cat "$OUT/server.log"; exit 1; }
+    sleep 1
+done
+grep -q "listening" "$OUT/server.log" || {
+    echo "ci_serve: server never started"; kill -9 "$SRV"; exit 1; }
+
+timeout -k 10 180 env GYM_TPU_CI_SERVE_PORT="$PORT" python - <<'EOF'
+import concurrent.futures, json, os, urllib.request
+
+port = os.environ["GYM_TPU_CI_SERVE_PORT"]
+
+def gen(seed):
+    body = json.dumps({"prompt": [1, 2, 3], "max_new_tokens": 6,
+                       "top_k": 4, "seed": seed}).encode()
+    r = urllib.request.urlopen(urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate", body,
+        {"Content-Type": "application/json"}), timeout=120)
+    return json.loads(r.read())
+
+with concurrent.futures.ThreadPoolExecutor(4) as ex:
+    outs = list(ex.map(gen, range(4)))
+assert len(outs) == 4
+for o in outs:
+    assert len(o["tokens"]) == 6, o
+    print("ci_serve: completion", o["tokens"], "ttft", o["ttft_s"])
+stats = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/stats", timeout=10).read())
+assert stats["requests_done"] == 4, stats
+print("ci_serve: tokens_per_s =", stats["tokens_per_s"])
+EOF
+rc=$?
+[ "$rc" -ne 0 ] && { echo "ci_serve: HTTP requests failed";
+    cat "$OUT/server.log"; kill -9 "$SRV"; exit "$rc"; }
+
+# SIGTERM drill: clean exit 0, shutdown line, headline line
+kill -TERM "$SRV"
+wait "$SRV"; rc=$?
+[ "$rc" -ne 0 ] && { echo "ci_serve: server exit rc=$rc after SIGTERM";
+    cat "$OUT/server.log"; exit 1; }
+grep -q "shut down cleanly" "$OUT/server.log" || {
+    echo "ci_serve: no clean-shutdown line"; cat "$OUT/server.log"; exit 1; }
+grep -q "tokens_per_s" "$OUT/server.log" || {
+    echo "ci_serve: no tokens_per_s headline"; cat "$OUT/server.log"; exit 1; }
+head -1 "$OUT/ckpts/ci/serve/serve.csv" | grep -q "ts_s,kind" || {
+    echo "ci_serve: serve.csv missing/markerless"; exit 1; }
+echo "ci_serve: OK (log at $OUT/server.log)"
+exit 0

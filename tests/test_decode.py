@@ -14,7 +14,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from gym_tpu.models.nanogpt import GPT, GPTConfig, generate, generate_fast
+from gym_tpu.models.nanogpt import (GPT, GPTConfig, generate, generate_fast,
+                                    sample_logits)
 
 
 def _setup():
@@ -92,6 +93,83 @@ def test_generate_fast_matches_generate_greedy():
     fast = generate_fast(params, cfg, np.asarray(idx), max_new_tokens=8,
                          top_k=1, seed=3)
     np.testing.assert_array_equal(slow, fast)
+
+
+@pytest.mark.parametrize("variant", ["bias_false", "moe", "moe_bias_false"])
+def test_cached_decode_matches_forward_variants(variant):
+    """Cached decode == full dense forward at EVERY position, beyond the
+    default config: bias=False drops every Dense/LayerNorm bias (a
+    different param tree through the same cache path), and MoE configs
+    route through `GPTConfig.is_moe_layer` blocks whose dispatch must be
+    position-independent under single-token decode."""
+    kw = dict(block_size=32, vocab_size=48, n_layer=2, n_head=2,
+              n_embd=32, dropout=0.0)
+    if "bias_false" in variant:
+        kw["bias"] = False
+    if "moe" in variant:
+        kw.update(n_experts=4, expert_topk=2)
+    cfg = GPTConfig(**kw)
+    model = GPT(cfg)
+    rng = jax.random.PRNGKey(1)
+    idx = jax.random.randint(rng, (2, 11), 0, cfg.vocab_size)
+    params = model.init({"params": rng}, idx, train=False)["params"]
+    full = model.apply({"params": params}, idx, train=False)
+
+    dmodel = GPT(dataclasses.replace(cfg, decode=True))
+    pre, varsc = dmodel.apply({"params": params}, idx[:, :4],
+                              train=False, mutable=["cache"])
+    np.testing.assert_allclose(np.asarray(pre), np.asarray(full[:, :4]),
+                               rtol=1e-4, atol=1e-5)
+    cache = varsc["cache"]
+    for j in range(4, idx.shape[1]):
+        lg, varsc = dmodel.apply({"params": params, "cache": cache},
+                                 idx[:, j:j + 1], train=False,
+                                 mutable=["cache"])
+        cache = varsc["cache"]
+        np.testing.assert_allclose(np.asarray(lg[:, 0]),
+                                   np.asarray(full[:, j]),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_generate_fast_overflow_raises_typed():
+    """prompt + max_new_tokens past the cache is a ValueError (not a bare
+    assert) that names `generate`'s context-crop fallback."""
+    cfg, model, params, idx = _setup()
+    with pytest.raises(ValueError, match="generate"):
+        generate_fast(params, cfg, np.asarray(idx),
+                      max_new_tokens=cfg.block_size)
+    # the documented fallback: `generate` crops context and keeps going
+    out = generate(params, cfg, np.asarray(idx)[:, :4],
+                   max_new_tokens=cfg.block_size + 2, top_k=1)
+    assert out.shape == (2, 4 + cfg.block_size + 2)
+
+
+def test_top_p_greedy_parity_generate_vs_fast():
+    """top_p small enough keeps only the argmax → both samplers become
+    greedy decoders and their trajectories must agree exactly (parity of
+    the numpy and jitted nucleus implementations)."""
+    cfg, model, params, idx = _setup()
+    slow = generate(params, cfg, np.asarray(idx), max_new_tokens=8,
+                    top_p=1e-9, seed=5)
+    fast = generate_fast(params, cfg, np.asarray(idx), max_new_tokens=8,
+                         top_p=1e-9, seed=5)
+    np.testing.assert_array_equal(slow, fast)
+
+
+def test_top_p_determinism_and_support():
+    """top_p sampling is deterministic per seed, and a tight nucleus
+    restricts samples to the top of the distribution."""
+    cfg, model, params, idx = _setup()
+    a = generate_fast(params, cfg, np.asarray(idx), 6, temperature=0.9,
+                      top_p=0.7, seed=11)
+    b = generate_fast(params, cfg, np.asarray(idx), 6, temperature=0.9,
+                      top_p=0.7, seed=11)
+    np.testing.assert_array_equal(a, b)
+    # crafted logits: nucleus p=0.6 keeps exactly the two dominant tokens
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.1, 0.06, 0.04]]))
+    seen = {int(sample_logits(logits, jax.random.PRNGKey(s),
+                              top_p=0.6)[0]) for s in range(64)}
+    assert seen <= {0, 1} and len(seen) == 2
 
 
 def test_decode_cache_overflow_poisons_output():

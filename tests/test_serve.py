@@ -1,0 +1,453 @@
+"""gym_tpu.serve — continuous-batching inference engine (ISSUE 4).
+
+Oracles:
+- single-request ENGINE == ``generate_fast`` token-for-token (same
+  sampling config + seed): both run the shared ``sample_logits`` kernel
+  on the same ``fold_in(PRNGKey(seed), token_index)`` key schedule, and
+  the per-row cache math is the same program modulo batch width.
+- teacher forcing: engine logits == the full dense forward at every
+  position (rtol 1e-4).
+- bounded compilation: N requests with N distinct prompt lengths compile
+  at most ``⌈log2(block_size)⌉ + 1`` prefill programs, not N.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from gym_tpu.models.nanogpt import GPT, GPTConfig, generate_fast
+from gym_tpu.serve.engine import (InferenceEngine, SamplingParams,
+                                  max_prefill_buckets, prompt_bucket)
+from gym_tpu.serve.metrics import ServeMetrics
+from gym_tpu.serve.scheduler import (QueueFullError, RequestStatus,
+                                     Scheduler)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = GPTConfig(block_size=64, vocab_size=48, n_layer=2, n_head=2,
+                    n_embd=32, dropout=0.0, bias=True)
+    model = GPT(cfg)
+    rng = jax.random.PRNGKey(0)
+    params = model.init({"params": rng}, np.zeros((1, 8), np.int64),
+                        train=False)["params"]
+    return cfg, model, params
+
+
+def _prompt(n, seed, vocab=48):
+    return np.asarray(jax.random.randint(jax.random.PRNGKey(seed), (n,),
+                                         0, vocab))
+
+
+def _drain(sched, handles, limit=5000):
+    for _ in range(limit):
+        if all(h.status in (RequestStatus.DONE, RequestStatus.FAILED)
+               for h in handles):
+            return
+        sched.step()
+    raise AssertionError("scheduler did not drain")
+
+
+# -- parity oracles -------------------------------------------------------
+
+
+def test_engine_matches_generate_fast_single_request(setup):
+    """Single request, sampling enabled: the engine's token stream is
+    IDENTICAL to generate_fast with the same config and seed."""
+    cfg, model, params = setup
+    prompt = _prompt(8, 1)
+    ref = generate_fast(params, cfg, prompt[None], 10, temperature=0.8,
+                        top_k=5, seed=3)
+    eng = InferenceEngine(params, cfg, num_slots=4)
+    slot, ev = eng.admit(prompt, SamplingParams(
+        max_new_tokens=10, temperature=0.8, top_k=5, seed=3))
+    toks = [ev.token]
+    while not ev.finished:
+        ev = eng.step()[0]
+        toks.append(ev.token)
+    assert toks == ref[0, 8:].tolist()
+
+
+def test_engine_matches_generate_fast_padded_prompt(setup):
+    """A non-power-of-2 prompt goes through the padded prefill bucket;
+    the token stream must still be exact (pad K/V is causally masked)."""
+    cfg, model, params = setup
+    prompt = _prompt(11, 2)
+    ref = generate_fast(params, cfg, prompt[None], 7, temperature=1.0,
+                        top_p=0.9, seed=5)
+    eng = InferenceEngine(params, cfg, num_slots=2)
+    slot, ev = eng.admit(prompt, SamplingParams(
+        max_new_tokens=7, top_p=0.9, seed=5))
+    toks = [ev.token]
+    while not ev.finished:
+        ev = [e for e in eng.step() if e.slot == slot][0]
+        toks.append(ev.token)
+    assert toks == ref[0, 11:].tolist()
+
+
+@pytest.mark.parametrize("chunk", [1, 4])
+def test_concurrent_requests_isolated(setup, chunk):
+    """Continuous batching with slot churn: 5 requests with different
+    lengths/seeds through 2 slots — every output equals its own solo
+    generate_fast run (rows cannot leak across slots), at both decode
+    granularities."""
+    cfg, model, params = setup
+    eng = InferenceEngine(params, cfg, num_slots=2, decode_chunk=chunk)
+    sched = Scheduler(eng, max_queue=8)
+    handles, wants = [], []
+    for i, (plen, mnew) in enumerate([(5, 7), (9, 12), (3, 4), (17, 9),
+                                      (8, 15)]):
+        prompt = _prompt(plen, 100 + i)
+        ref = generate_fast(params, cfg, prompt[None], mnew,
+                            temperature=0.9, top_k=7, top_p=0.95, seed=i)
+        wants.append(ref[0, plen:].tolist())
+        handles.append(sched.submit(prompt, SamplingParams(
+            max_new_tokens=mnew, temperature=0.9, top_k=7, top_p=0.95,
+            seed=i)))
+    _drain(sched, handles)
+    for h, want in zip(handles, wants):
+        assert h.result(timeout=1) == want
+        assert h.ttft_s is not None and h.ttft_s >= 0
+
+
+def test_eos_token_stops_midstream(setup):
+    """EOS eviction: pin eos to a token known to appear mid-trajectory;
+    the request stops there (inclusive) even mid-chunk."""
+    cfg, model, params = setup
+    prompt = _prompt(9, 3)
+    ref = generate_fast(params, cfg, prompt[None], 12, temperature=0.9,
+                        top_k=7, seed=1)[0, 9:].tolist()
+    eos = ref[4]
+    assert eos not in ref[:4]  # the pin is meaningful
+    eng = InferenceEngine(params, cfg, num_slots=2, decode_chunk=4)
+    sched = Scheduler(eng, max_queue=4)
+    h = sched.submit(prompt, SamplingParams(
+        max_new_tokens=12, temperature=0.9, top_k=7, seed=1,
+        eos_token=eos))
+    _drain(sched, [h])
+    assert h.result(timeout=1) == ref[:5]
+
+
+def test_teacher_forcing_logits_match_dense_forward(setup):
+    """Teacher forcing through the engine: feed the ground-truth token at
+    every step; the engine's logits equal the full dense forward at each
+    position (the ISSUE 4 acceptance oracle)."""
+    cfg, model, params = setup
+    seq = _prompt(16, 7)[None]                      # [1, 16]
+    full = np.asarray(model.apply({"params": params}, seq, train=False))
+    k = 6
+    eng = InferenceEngine(params, cfg, num_slots=3)
+    slot, _ = eng.admit(seq[0, :k], SamplingParams(max_new_tokens=16))
+    for j in range(k, seq.shape[1]):
+        # the cache holds positions < j; force the true token at j — the
+        # step's logits are the model's prediction AT position j
+        eng.step(override_tokens={slot: int(seq[0, j])})
+        np.testing.assert_allclose(eng.last_logits[slot], full[0, j],
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_teacher_forcing_with_chunked_engine(setup):
+    """override_tokens must force a single-step program even when the
+    engine decodes in chunks — per-step logits stay observable."""
+    cfg, model, params = setup
+    seq = _prompt(12, 9)[None]
+    full = np.asarray(model.apply({"params": params}, seq, train=False))
+    eng = InferenceEngine(params, cfg, num_slots=2, decode_chunk=4)
+    slot, _ = eng.admit(seq[0, :5], SamplingParams(max_new_tokens=12))
+    eng.step(override_tokens={slot: int(seq[0, 5])})
+    np.testing.assert_allclose(eng.last_logits[slot], full[0, 5],
+                               rtol=1e-4, atol=1e-5)
+
+
+# -- bounded compilation --------------------------------------------------
+
+
+def test_prompt_bucketing_bounds_compiles(setup):
+    """N requests with N distinct prompt lengths trigger at most
+    ⌈log2(block_size)⌉ + 1 prefill compilations — not N."""
+    cfg, model, params = setup
+    eng = InferenceEngine(params, cfg, num_slots=2)
+    sched = Scheduler(eng, max_queue=64)
+    lengths = list(range(1, 33))                    # 32 distinct lengths
+    handles = [sched.submit(_prompt(n, 200 + n),
+                            SamplingParams(max_new_tokens=2, seed=n))
+               for n in lengths]
+    _drain(sched, handles)
+    for h in handles:
+        assert len(h.result(timeout=1)) == 2
+    bound = max_prefill_buckets(cfg.block_size)     # ⌈log2(64)⌉ + 1 = 7
+    assert bound == 7
+    assert len(eng.stats.prefill_buckets) <= bound
+    assert eng.stats.prefill_compiles <= bound
+    assert eng.stats.prefills == len(lengths)
+
+
+def test_prompt_bucket_function():
+    assert [prompt_bucket(n, 64) for n in (1, 2, 3, 5, 8, 9, 33, 64)] \
+        == [1, 2, 4, 8, 8, 16, 64, 64]
+    assert prompt_bucket(1000, 64) == 64            # capped at block_size
+    with pytest.raises(ValueError):
+        prompt_bucket(0, 64)
+    assert max_prefill_buckets(1024) == 11
+
+
+# -- request/queue semantics ----------------------------------------------
+
+
+def test_submit_backpressure(setup):
+    cfg, model, params = setup
+    eng = InferenceEngine(params, cfg, num_slots=1)
+    sched = Scheduler(eng, max_queue=2)
+    for i in range(2):
+        sched.submit(_prompt(4, i), SamplingParams(max_new_tokens=4))
+    with pytest.raises(QueueFullError):
+        sched.submit(_prompt(4, 9), SamplingParams(max_new_tokens=4),
+                     block=False)
+    with pytest.raises(QueueFullError):
+        sched.submit(_prompt(4, 9), SamplingParams(max_new_tokens=4),
+                     timeout=0.05)
+    # draining the queue unblocks submission again
+    for _ in range(200):
+        sched.step()
+        if sched.queue_depth() == 0 and sched.active_requests() == 0:
+            break
+    sched.submit(_prompt(4, 9), SamplingParams(max_new_tokens=4),
+                 block=False)
+
+
+def test_oversized_request_rejected_typed(setup):
+    """A request that can never fit the KV cache fails AT SUBMIT with the
+    same typed ValueError generate_fast raises — it must not occupy a
+    slot or poison the batch."""
+    cfg, model, params = setup
+    eng = InferenceEngine(params, cfg, num_slots=2)
+    sched = Scheduler(eng, max_queue=4)
+    with pytest.raises(ValueError, match="exceeds the KV cache"):
+        sched.submit(_prompt(40, 0),
+                     SamplingParams(max_new_tokens=40))
+    with pytest.raises(ValueError):
+        generate_fast(params, cfg, _prompt(40, 0)[None], 40)
+    # out-of-vocab ids would be silently CLAMPED by the embedding gather
+    with pytest.raises(ValueError, match="token ids"):
+        sched.submit(np.asarray([1, 2, cfg.vocab_size]),
+                     SamplingParams(max_new_tokens=2))
+    # temperature 0 is logits/0 -> NaN, not greedy
+    with pytest.raises(ValueError, match="temperature"):
+        sched.submit(_prompt(4, 0),
+                     SamplingParams(max_new_tokens=2, temperature=0.0))
+
+
+def test_shutdown_answers_running_fails_queued(setup):
+    """The SIGTERM drain contract: running requests are answered, queued
+    ones are failed with a reported error — nothing hangs, nothing is
+    silently dropped."""
+    cfg, model, params = setup
+    eng = InferenceEngine(params, cfg, num_slots=1)
+    sched = Scheduler(eng, max_queue=8)
+    running = sched.submit(_prompt(4, 0), SamplingParams(max_new_tokens=6))
+    queued = sched.submit(_prompt(4, 1), SamplingParams(max_new_tokens=6))
+    sched.step()                       # admit `running` into the one slot
+    assert running.status is RequestStatus.RUNNING
+    sched.shutdown(finish_running=True)
+    assert running.status is RequestStatus.DONE
+    assert len(running.result(timeout=1)) == 6
+    assert queued.status is RequestStatus.FAILED
+    with pytest.raises(RuntimeError, match="shutting down"):
+        queued.result(timeout=1)
+    with pytest.raises(RuntimeError, match="shutting down"):
+        sched.submit(_prompt(4, 2), SamplingParams(max_new_tokens=2))
+
+
+def test_scheduler_threaded_run_loop(setup):
+    """submit from a foreign thread while the driver loop runs — the
+    production topology of the HTTP server."""
+    cfg, model, params = setup
+    eng = InferenceEngine(params, cfg, num_slots=2)
+    sched = Scheduler(eng, max_queue=8)
+    stop = threading.Event()
+    t = threading.Thread(target=sched.run, args=(stop,), daemon=True)
+    t.start()
+    try:
+        hs = [sched.submit(_prompt(5, i), SamplingParams(
+            max_new_tokens=5, seed=i)) for i in range(4)]
+        for h in hs:
+            assert len(h.result(timeout=60)) == 5
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert not t.is_alive()
+
+
+# -- metrics --------------------------------------------------------------
+
+
+def test_serve_metrics_csv(setup, tmp_path):
+    cfg, model, params = setup
+    eng = InferenceEngine(params, cfg, num_slots=2)
+    metrics = ServeMetrics(str(tmp_path), engine_log_every=1)
+    sched = Scheduler(eng, max_queue=8, metrics=metrics)
+    hs = [sched.submit(_prompt(4, i), SamplingParams(
+        max_new_tokens=4, seed=i)) for i in range(3)]
+    while any(h.status in (RequestStatus.QUEUED, RequestStatus.RUNNING)
+              for h in hs):
+        sched.step()
+        metrics.engine_tick(eng.stats, queue_depth=sched.queue_depth())
+    metrics.sync()
+    head = metrics.headline()
+    assert head["requests_done"] == 3
+    assert head["tokens_out"] == 12
+    assert head["tokens_per_s"] > 0
+    assert head["mean_ttft_s"] is not None
+    with open(os.path.join(str(tmp_path), "serve.csv")) as f:
+        rows = f.read().strip().splitlines()
+    assert rows[0].startswith("ts_s,kind,request_id")
+    kinds = {r.split(",")[1] for r in rows[1:]}
+    assert kinds == {"request", "engine"}
+    req_rows = [r for r in rows[1:] if r.split(",")[1] == "request"]
+    assert len(req_rows) == 3
+    metrics.close()
+    # a restart over the same dir APPENDS (no history destruction, one
+    # header)
+    m2 = ServeMetrics(str(tmp_path), engine_log_every=1)
+    m2.engine_tick(eng.stats, queue_depth=0)
+    m2.close()
+    with open(os.path.join(str(tmp_path), "serve.csv")) as f:
+        rows2 = f.read().strip().splitlines()
+    assert len(rows2) == len(rows) + 1
+    assert sum(r.startswith("ts_s,kind") for r in rows2) == 1
+
+
+# -- params-only restore --------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def trained_run_dir(tmp_path_factory):
+    """A real (tiny) fit with checkpointing — the serving input."""
+    from gym_tpu import Trainer
+    from gym_tpu.data import ArrayDataset
+    from gym_tpu.strategy.optim import OptimSpec
+    from gym_tpu.strategy.simple_reduce import SimpleReduceStrategy
+
+    tmp = tmp_path_factory.mktemp("serve_ckpt")
+    cfg = GPTConfig(block_size=32, vocab_size=48, n_layer=2, n_head=2,
+                    n_embd=32, dropout=0.0)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 48, (64, 33))
+    ds = ArrayDataset(toks[:, :-1].astype(np.int64),
+                      toks[:, 1:].astype(np.int64))
+    res = Trainer(GPT(cfg), ds).fit(
+        strategy=SimpleReduceStrategy(optim_spec=OptimSpec("adamw",
+                                                           lr=1e-3)),
+        num_nodes=2, max_steps=6, batch_size=4, val_size=0,
+        val_interval=0, show_progress=False, seed=1,
+        checkpoint_interval=3, save_dir=str(tmp / "ckpts"),
+        run_name="serve_test", log_dir=str(tmp / "logs"))
+    return str(tmp / "ckpts" / "serve_test"), cfg, res
+
+
+def test_params_only_restore_matches_fit_result(trained_run_dir):
+    """load_for_serving == FitResult.params (node-averaged), config
+    rebuilt from the in-run-dir config.json snapshot."""
+    from gym_tpu.serve.load import load_for_serving
+
+    run_dir, cfg, res = trained_run_dir
+    assert os.path.exists(os.path.join(run_dir, "config.json"))
+    params, lcfg, info = load_for_serving(run_dir)
+    assert info["step"] == 6 and info["num_nodes"] == 2
+    assert (lcfg.block_size, lcfg.vocab_size, lcfg.n_layer) == (32, 48, 2)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(res.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_restored_params_serve_and_generate(trained_run_dir):
+    """The restored (params, config) pair drives both generate_fast and
+    the engine; the two agree (the oracle holds on REAL checkpoints, not
+    just hand-built params)."""
+    from gym_tpu.serve.load import load_for_serving
+
+    run_dir, _, _ = trained_run_dir
+    params, cfg, _ = load_for_serving(run_dir)
+    prompt = _prompt(6, 4, vocab=cfg.vocab_size)
+    ref = generate_fast(params, cfg, prompt[None], 8, temperature=0.7,
+                        top_k=8, seed=2)
+    eng = InferenceEngine(params, cfg, num_slots=2)
+    slot, ev = eng.admit(prompt, SamplingParams(
+        max_new_tokens=8, temperature=0.7, top_k=8, seed=2))
+    toks = [ev.token]
+    while not ev.finished:
+        ev = eng.step()[0]
+        toks.append(ev.token)
+    assert toks == ref[0, 6:].tolist()
+
+
+def test_restore_missing_and_pinned_steps(trained_run_dir, tmp_path):
+    from gym_tpu.serve.load import load_for_serving
+    from gym_tpu.utils.checkpoint import (CheckpointNotFoundError,
+                                          restore_params)
+
+    run_dir, _, _ = trained_run_dir
+    with pytest.raises(CheckpointNotFoundError):
+        load_for_serving(str(tmp_path / "nope"))
+    empty = tmp_path / "empty_run"
+    empty.mkdir()
+    with pytest.raises(CheckpointNotFoundError):
+        restore_params(str(empty))
+    with pytest.raises(CheckpointNotFoundError):
+        restore_params(run_dir, step=999)
+    step, params, _ = restore_params(run_dir, step=3)   # pinned older step
+    assert step == 3 and jax.tree.leaves(params)
+
+
+def test_restore_skips_corrupt_newest_readonly(trained_run_dir):
+    """A torn newest step dir is skipped (older step served) WITHOUT
+    being quarantined/renamed — serving must not mutate a run dir the
+    trainer may still own."""
+    import shutil
+
+    from gym_tpu.utils.checkpoint import restore_params
+
+    run_dir, _, _ = trained_run_dir
+    src = os.path.join(run_dir, "6")
+    bak = os.path.join(run_dir, "_bak6")
+    shutil.copytree(src, bak)
+    try:
+        # tear the newest step: truncate every array data file
+        for root, _dirs, files in os.walk(src):
+            for f in files:
+                if "zarray" not in f and f != "_CHECKPOINT_METADATA":
+                    with open(os.path.join(root, f), "w") as fh:
+                        fh.write("")
+        step, params, _ = restore_params(run_dir)
+        assert step == 3
+        assert os.path.isdir(src)                   # still in place
+        assert not [d for d in os.listdir(run_dir) if "corrupt" in d]
+    finally:
+        shutil.rmtree(src, ignore_errors=True)
+        os.rename(bak, src)
+
+
+def test_moe_config_sanitized_for_serving(setup):
+    """A training config pinned to einsum dispatch + expert sharding
+    serves through the engine (decode_config strips both) — MoE requests
+    decode without token drops."""
+    cfg = GPTConfig(block_size=32, vocab_size=48, n_layer=2, n_head=2,
+                    n_embd=32, dropout=0.0, n_experts=4, expert_topk=2,
+                    moe_impl="einsum")
+    model = GPT(cfg)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        np.zeros((1, 8), np.int64),
+                        train=False)["params"]
+    ref = generate_fast(params, cfg, _prompt(6, 0)[None], 5, top_k=4,
+                        seed=1)
+    eng = InferenceEngine(params, cfg, num_slots=2)
+    slot, ev = eng.admit(_prompt(6, 0), SamplingParams(
+        max_new_tokens=5, top_k=4, seed=1))
+    toks = [ev.token]
+    while not ev.finished:
+        ev = eng.step()[0]
+        toks.append(ev.token)
+    assert toks == ref[0, 6:].tolist()
