@@ -77,9 +77,10 @@ def _classify_and_report(blob: str, detail: str) -> int:
 
 def _supervise() -> int:
     """Probe the accelerator, then run the measurement under a watchdog."""
-    # --sim-only is host-side by construction (the network is MODELED;
-    # the tiny measured fits are CPU-sized) — never touch the accelerator
-    force_cpu = "--cpu" in sys.argv or "--sim-only" in sys.argv
+    # --sim-only / --chaos-only are host-side by construction (modeled
+    # network; injected host faults) — never touch the accelerator
+    force_cpu = ("--cpu" in sys.argv or "--sim-only" in sys.argv
+                 or "--chaos-only" in sys.argv)
     if not force_cpu:
         probe_cmd = [sys.executable, "-c",
                      "import jax; print('PLATFORM=' + jax.devices()[0].platform)"]
@@ -541,8 +542,151 @@ def measure_serving() -> dict:
     }
 
 
+def measure_chaos() -> dict:
+    """The ISSUE 5 rider: the serving stack under injected faults — the
+    SAME mixed-request workload served (a) clean and (b) with a delay
+    fault on every decode dispatch plus one injected HANG mid-run (the
+    supervisor recovery drill) and a burst of infeasible-deadline
+    submissions (the admission-control shed). Reports tail latencies
+    (p50/p95/p99 TTFT + per-token) for both arms and the shed /
+    quarantined / restart counters — the "serving under fire" headline.
+
+    Host-side by construction (the faults are host faults); always
+    CPU-forced like --sim-only. Both arms run warm (a warmup request
+    precedes them) so the deltas are fault cost, not compile cost."""
+    import tempfile
+
+    import numpy as np
+
+    from gym_tpu.models.nanogpt import GPT, GPTConfig
+    from gym_tpu.serve.engine import InferenceEngine, SamplingParams
+    from gym_tpu.serve.metrics import ServeMetrics
+    from gym_tpu.serve.scheduler import (AdmissionRejectedError,
+                                         Scheduler)
+    from gym_tpu.serve.supervisor import Supervisor
+    from gym_tpu.utils.resilience import faults
+
+    import jax
+
+    num_slots = int(os.environ.get("GYM_TPU_BENCH_CHAOS_SLOTS", 4))
+    n_req = int(os.environ.get("GYM_TPU_BENCH_CHAOS_REQUESTS", 16))
+    cfg = GPTConfig(block_size=128, vocab_size=65, n_layer=2, n_head=2,
+                    n_embd=64, dropout=0.0, bias=True)
+    model = GPT(cfg)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        np.zeros((1, 8), np.int64), train=False)["params"]
+
+    rng = np.random.default_rng(0)
+    sigs = set()
+    while len(sigs) < n_req:
+        sigs.add((int(rng.integers(4, 32)), int(rng.integers(8, 24))))
+    workload = [
+        (rng.integers(0, cfg.vocab_size, plen), SamplingParams(
+            max_new_tokens=mnew, temperature=0.9, top_k=16, seed=i))
+        for i, (plen, mnew) in enumerate(sorted(sigs))
+    ]
+
+    def engine_factory():
+        return InferenceEngine(params, cfg, num_slots=num_slots,
+                               decode_chunk=2)
+
+    def run_arm(fault_spec: str) -> dict:
+        faults.reset()
+        if fault_spec:
+            faults.configure(fault_spec)
+        out = tempfile.mkdtemp(prefix="gym_tpu_chaos_")
+        metrics = ServeMetrics(out, engine_log_every=10)
+        sched = Scheduler(engine_factory(), max_queue=64, metrics=metrics)
+        sup = Supervisor(sched, engine_factory, dispatch_timeout_s=1.0,
+                         max_restarts=4, metrics=metrics,
+                         log=lambda *a, **k: None)
+        sup.start()
+        handles = [sched.submit(p, sp, deadline_s=120.0)
+                   for p, sp in workload]
+        # wait out half the workload so the tokens/s EWMA is live, then
+        # fire the admission-control shed: deliberately infeasible
+        # deadlines must be rejected up front, not queued to die
+        for h in handles[:n_req // 2]:
+            try:
+                h.result(timeout=300)
+            except (RuntimeError, OSError):   # OSError covers
+                pass                          # TimeoutError + IO faults
+        rejected = 0
+        for k in range(3):
+            try:
+                sched.submit(workload[0][0], SamplingParams(
+                    max_new_tokens=48, seed=100 + k), deadline_s=1e-4)
+            except AdmissionRejectedError:
+                rejected += 1
+        outcomes = {"ok": 0, "failed": 0}
+        for h in handles:
+            try:
+                h.result(timeout=300)
+                outcomes["ok"] += 1
+            except (RuntimeError, OSError):
+                outcomes["failed"] += 1
+        # post-chaos probe: faults off, the engine must serve cleanly
+        faults.reset()
+        post_ok = False
+        try:
+            post = sched.submit(workload[0][0], SamplingParams(
+                max_new_tokens=8, seed=999), deadline_s=60.0)
+            post_ok = len(post.result(timeout=60)) == 8
+        except (RuntimeError, OSError):
+            post_ok = False
+        sup.stop(join_timeout_s=30)
+        sched.shutdown(finish_running=False)
+        head = metrics.headline()
+        metrics.close()
+        return {
+            "requests_ok": outcomes["ok"],
+            "requests_failed_typed": outcomes["failed"],
+            "shed_at_admission": rejected,
+            "requests_shed": head["requests_shed"],
+            "requests_quarantined": head["requests_quarantined"],
+            "engine_restarts": sup.restarts,
+            "post_chaos_request_ok": post_ok,
+            "tokens_per_s": head["tokens_per_s"],
+            "ttft_p50_s": head["ttft_p50_s"],
+            "ttft_p95_s": head["ttft_p95_s"],
+            "ttft_p99_s": head["ttft_p99_s"],
+            "token_lat_p50_s": head["token_lat_p50_s"],
+            "token_lat_p95_s": head["token_lat_p95_s"],
+            "token_lat_p99_s": head["token_lat_p99_s"],
+        }
+
+    # warm the global program LRUs — one request PER PREFILL BUCKET the
+    # workload can hit, so neither arm's tail latency absorbs a compile
+    warm_sched = Scheduler(engine_factory(), max_queue=8)
+    warm = [warm_sched.submit(np.ones(n, np.int32),
+                              SamplingParams(max_new_tokens=4))
+            for n in (4, 8, 16, 31)]
+    while any(w.status.value in ("queued", "running") for w in warm):
+        warm_sched.step()
+
+    clean = run_arm("")
+    # delay every decode dispatch 20 ms + one 4 s hang mid-run (the 1 s
+    # watchdog reaps it; the abandoned thread wakes while the arm is
+    # still running and is discarded by the scheduler epoch)
+    faulted = run_arm("serve.decode:delay=0.02,serve.decode:hang=4@9")
+    return {
+        "metric": "serving_under_faults_tail_latency",
+        "workload": (f"{n_req} requests, distinct (prompt_len in [4,32), "
+                     f"max_new in [8,24)) signatures, gpt "
+                     f"{cfg.n_layer}L/{cfg.n_embd}d block "
+                     f"{cfg.block_size}, {num_slots} slots, chunk 2, "
+                     f"watchdog 1s"),
+        "fault_spec": "serve.decode:delay=0.02 + serve.decode:hang=4@9",
+        "clean": clean,
+        "faulted": faulted,
+        "recovered": bool(faulted["engine_restarts"] >= 1
+                          and faulted["post_chaos_request_ok"]),
+    }
+
+
 def main() -> None:
-    force_cpu = "--cpu" in sys.argv or "--sim-only" in sys.argv
+    force_cpu = ("--cpu" in sys.argv or "--sim-only" in sys.argv
+                 or "--chaos-only" in sys.argv)
     if force_cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
 
@@ -577,6 +721,10 @@ def main() -> None:
 
     if "--serve-only" in sys.argv:
         print(json.dumps({"serving": measure_serving()}))
+        return
+
+    if "--chaos-only" in sys.argv:
+        print(json.dumps({"chaos": measure_chaos()}))
         return
 
     import numpy as np

@@ -16,7 +16,13 @@ concurrent load:
   free slots and leave on EOS/max-tokens BETWEEN decode steps —
   continuous batching, no drain-the-batch barrier.
 - ``scheduler``: FCFS request queue, slot assignment, and a
-  backpressure-bounded submit/poll API.
+  backpressure-bounded submit/poll API — with per-request deadlines
+  (queued requests past deadline shed before prefill, running ones
+  cancelled at chunk boundaries) and EWMA-based admission control
+  (infeasible deadlines rejected typed before they are enqueued).
+- ``supervisor``: self-healing driver loop — every dispatch runs under a
+  watchdog; an engine crash or wedge fails in-flight requests typed,
+  rebuilds the engine warm (global program LRUs) and resumes the queue.
 - ``load``: params-only checkpoint restore — a ``fit(save_dir=...)`` run
   dir serves directly, no optimizer-state template needed.
 - ``metrics``: per-request TTFT / per-token latency and engine
@@ -29,10 +35,17 @@ concurrent load:
 from .engine import EngineStats, InferenceEngine, SamplingParams
 from .load import load_for_serving
 from .metrics import ServeMetrics
-from .scheduler import QueueFullError, Request, RequestStatus, Scheduler
+from .scheduler import (AdmissionRejectedError, DeadlineExceededError,
+                        EngineFailedError, QueueFullError, Request,
+                        RequestStatus, Scheduler, SchedulerClosedError,
+                        SlotQuarantinedError)
+from .supervisor import Supervisor
 
 __all__ = [
     "InferenceEngine", "SamplingParams", "EngineStats",
     "Scheduler", "Request", "RequestStatus", "QueueFullError",
+    "SchedulerClosedError", "DeadlineExceededError",
+    "AdmissionRejectedError", "EngineFailedError",
+    "SlotQuarantinedError", "Supervisor",
     "load_for_serving", "ServeMetrics",
 ]

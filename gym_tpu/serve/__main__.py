@@ -1,36 +1,65 @@
 """``python -m gym_tpu.serve --ckpt <run_dir>`` — stdlib-HTTP serving.
 
-No framework: ``http.server.ThreadingHTTPServer`` + the scheduler. One
-driver thread runs the engine loop; handler threads submit and block on
-the request future. Endpoints:
+No framework: ``http.server.ThreadingHTTPServer`` + the scheduler under
+an engine ``Supervisor``. One driver thread runs the engine loop inside
+a watchdog; handler threads submit and block on the request future.
+Endpoints:
 
 - ``POST /generate`` — JSON body with either ``prompt`` (a list of token
   ids) or ``text`` (char-level corpora only: encoded via the shakespeare
   ``CHAR_VOCAB``), plus optional ``max_new_tokens`` / ``temperature`` /
-  ``top_k`` / ``top_p`` / ``eos_token`` / ``seed``. Replies with the new
+  ``top_k`` / ``top_p`` / ``eos_token`` / ``seed`` / ``deadline_s``.
+  ``deadline_s`` (also settable per request via the ``X-Deadline-S``
+  header; the body field wins) bounds the request end to end: admission
+  control rejects it up front (HTTP 429 + ``Retry-After``) when the
+  live tokens/s EWMA says the backlog cannot meet it; a queued request
+  past deadline is shed before prefill and a running one cancelled at
+  the next chunk boundary (HTTP 504, typed). Replies with the new
   ``tokens`` (and ``text`` when the vocab is char-level), TTFT and
   per-token latency.
-- ``GET /stats`` (alias ``/healthz``) — engine + metrics headline JSON.
+- ``GET /stats`` (alias ``/healthz``) — engine + metrics headline JSON,
+  including supervisor state (engine generation / restarts).
+
+Typed failure → status mapping (never a traceback-500 for a fault the
+serving stack understands):
+
+====================== ======================================
+400                     malformed JSON / bad params / prompt
+                        too long (typed ``ValueError`` body)
+429 + ``Retry-After``   queue full, admission-control reject
+503 + ``Retry-After``   shutting down, engine failed/rebuilt,
+                        slot quarantined (NaN), injected IO
+504                     deadline exceeded (shed or cancelled)
+====================== ======================================
 
 Shutdown drill (ISSUE 4 acceptance): SIGTERM/SIGINT triggers a graceful
-drain — stop accepting, FAIL queued requests ("shutting down", reported
-to their waiting handlers, never dropped), ANSWER in-flight requests
-(the engine keeps stepping until the running slots finish, bounded by
+drain — stop accepting, FAIL queued requests (typed, reported to their
+waiting handlers, never dropped), ANSWER in-flight requests (the engine
+keeps stepping until the running slots finish, bounded by
 ``--drain-deadline``), close the listener, flush ``serve.csv``, print a
 final ``tokens_per_s`` headline, exit 0. A wedged drain dumps every
 thread's stack (``utils.resilience.dump_thread_stacks``) instead of
 hanging silently.
+
+Chaos drill (ISSUE 5 acceptance, ``scripts/ci_chaos.sh``): with
+``GYM_TPU_FAULTS=serve.decode:hang@…`` injected the supervisor abandons
+the wedged driver, fails in-flight requests typed (503, inside their
+deadline), rebuilds the engine warm and keeps serving — the HTTP server
+never dies with its engine.
 """
 
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
+import math
 import os
 import signal
 import sys
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
 
 import numpy as np
 
@@ -51,11 +80,26 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--num_slots", type=int, default=4,
                    help="concurrent decode slots (the batch width)")
+    p.add_argument("--decode_chunk", type=int, default=1,
+                   help="decode steps fused per dispatch (chunk boundary "
+                        "= deadline-cancellation granularity)")
     p.add_argument("--max_queue", type=int, default=64,
                    help="FCFS queue bound (backpressure: submits beyond "
-                        "it wait, then 503)")
+                        "it wait, then 429)")
     p.add_argument("--request_timeout", type=float, default=600.0,
                    help="per-request wall-clock bound inside a handler")
+    p.add_argument("--default-deadline", type=float, default=None,
+                   help="deadline_s applied to requests that don't set "
+                        "one (default: none)")
+    p.add_argument("--dispatch-timeout", type=float,
+                   default=float(os.environ.get(
+                       "GYM_TPU_SERVE_WATCHDOG_S", 120.0)),
+                   help="supervisor watchdog: a dispatch wedged past this "
+                        "triggers engine failover (env "
+                        "GYM_TPU_SERVE_WATCHDOG_S)")
+    p.add_argument("--max-restarts", type=int, default=5,
+                   help="engine rebuilds before the supervisor declares "
+                        "the engine unrecoverable")
     p.add_argument("--drain-deadline", type=float, default=300.0,
                    help="SIGTERM: max seconds to finish in-flight "
                         "requests before failing them")
@@ -67,36 +111,81 @@ def _build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def main(argv=None) -> int:
-    args = _build_parser().parse_args(argv)
-    if args.device == "cpu":
-        os.environ.setdefault("JAX_PLATFORMS", "cpu")
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+@dataclasses.dataclass
+class ServerHandle:
+    """Everything a caller (main() or an in-process test) needs to drive
+    and tear down one serving stack."""
 
+    httpd: ThreadingHTTPServer
+    scheduler: Any
+    supervisor: Any
+    metrics: Any
+    engine_factory: Any
+    info: Dict[str, Any]
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def close(self, drain_deadline_s: float = 30.0) -> None:
+        """Test-path teardown: stop the driver, drain, close sockets."""
+        if self.supervisor.stop(join_timeout_s=drain_deadline_s):
+            self.scheduler.shutdown(finish_running=True,
+                                    deadline_s=drain_deadline_s)
+        else:
+            # driver wedged: never step the engine from here, but DO
+            # fail queued + in-flight futures typed — handler threads
+            # blocked in result() must not pin server_close open
+            self.scheduler.shutdown(finish_running=False, deadline_s=0.0)
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.metrics.close()
+
+
+def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
+                  num_slots: int = 4, decode_chunk: int = 1,
+                  max_queue: int = 64, request_timeout: float = 600.0,
+                  default_deadline: Optional[float] = None,
+                  dispatch_timeout: float = 120.0, max_restarts: int = 5,
+                  metrics_dir: Optional[str] = None,
+                  info: Optional[Dict[str, Any]] = None,
+                  stop_event: Optional[threading.Event] = None
+                  ) -> ServerHandle:
+    """Build the full serving stack — engine, scheduler, supervisor,
+    metrics, HTTP server — WITHOUT entering ``serve_forever``. ``main``
+    and the in-process chaos tests share this path, so what the tests
+    exercise is exactly what ``python -m gym_tpu.serve`` runs.
+    ``port=0`` binds an ephemeral port (``handle.port`` reports it)."""
     from ..data.build_dataset import CHAR_VOCAB
-    from ..utils.checkpoint import CheckpointNotFoundError
-    from ..utils.resilience import dump_thread_stacks
+    from ..utils.resilience import fault_point
     from .engine import InferenceEngine, SamplingParams
-    from .load import load_for_serving
     from .metrics import ServeMetrics
-    from .scheduler import QueueFullError, Scheduler
+    from .scheduler import (AdmissionRejectedError, DeadlineExceededError,
+                            EngineFailedError, QueueFullError, Scheduler,
+                            SchedulerClosedError, SlotQuarantinedError)
+    from .supervisor import Supervisor
 
-    try:
-        params, cfg, info = load_for_serving(
-            args.ckpt, step=args.step, config_path=args.config)
-    except (CheckpointNotFoundError, FileNotFoundError, ValueError) as e:
-        print(f"gym_tpu.serve: cannot load {args.ckpt}: {e}",
-              file=sys.stderr)
-        return 1
-    print(f"gym_tpu.serve: restored step {info['step']} "
-          f"({info['num_nodes']}-node average) from {args.ckpt}",
-          flush=True)
+    info = dict(info or {"step": None, "num_nodes": None})
+    stop = stop_event or threading.Event()
+    if metrics_dir is None:
+        # per-instance dir: a fixed shared default would interleave two
+        # servers' rows in one append-mode serve.csv
+        import tempfile
+        metrics_dir = tempfile.mkdtemp(prefix="gym_tpu_serve_")
 
-    engine = InferenceEngine(params, cfg, num_slots=args.num_slots)
-    metrics = ServeMetrics(args.metrics_dir
-                           or os.path.join(args.ckpt, "serve"))
-    sched = Scheduler(engine, max_queue=args.max_queue, metrics=metrics)
+    def engine_factory():
+        # the params live in memory (restored from the checkpoint at
+        # startup); the global prefill/decode program LRUs make a rebuild
+        # warm — same config, no recompiles
+        return InferenceEngine(params, cfg, num_slots=num_slots,
+                               decode_chunk=decode_chunk)
+
+    metrics = ServeMetrics(metrics_dir)
+    sched = Scheduler(engine_factory(), max_queue=max_queue,
+                      metrics=metrics)
+    sup = Supervisor(sched, engine_factory,
+                     dispatch_timeout_s=dispatch_timeout,
+                     max_restarts=max_restarts, metrics=metrics)
     char_level = cfg.vocab_size <= len(CHAR_VOCAB) + 1
 
     def encode_text(text: str):
@@ -111,21 +200,20 @@ def main(argv=None) -> int:
         return "".join(CHAR_VOCAB[t] for t in tokens
                        if 0 <= t < len(CHAR_VOCAB))
 
-    stop = threading.Event()
-    loop = threading.Thread(target=sched.run, args=(stop,),
-                            name="gym-tpu-serve-loop", daemon=True)
-    loop.start()
-
     class Handler(BaseHTTPRequestHandler):
         # quiet structured access log — one line per request on stderr
         def log_message(self, fmt, *a):
             sys.stderr.write("gym_tpu.serve: " + fmt % a + "\n")
 
-        def _reply(self, code: int, payload: dict):
+        def _reply(self, code: int, payload: dict,
+                   retry_after_s: Optional[float] = None):
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if retry_after_s is not None:
+                self.send_header("Retry-After",
+                                 str(max(1, math.ceil(retry_after_s))))
             self.end_headers()
             self.wfile.write(body)
 
@@ -133,9 +221,10 @@ def main(argv=None) -> int:
             if self.path not in ("/stats", "/healthz"):
                 self._reply(404, {"error": f"unknown path {self.path}"})
                 return
-            s = engine.stats
+            s = sched.engine.stats
             self._reply(200, {
-                "status": "draining" if stop.is_set() else "ok",
+                "status": ("draining" if stop.is_set() else
+                           "degraded" if sup.failed is not None else "ok"),
                 "step": info["step"],
                 "num_slots": s.num_slots,
                 "active_slots": s.active_slots,
@@ -144,6 +233,7 @@ def main(argv=None) -> int:
                 "decode_steps": s.decode_steps,
                 "prefills": s.prefills,
                 "prefill_buckets": list(s.prefill_buckets),
+                **sup.status(),
                 **metrics.headline(),
             })
 
@@ -152,8 +242,17 @@ def main(argv=None) -> int:
                 self._reply(404, {"error": f"unknown path {self.path}"})
                 return
             try:
+                fault_point("serve.http")
                 n = int(self.headers.get("Content-Length", 0))
-                body = json.loads(self.rfile.read(n) or b"{}")
+                raw = self.rfile.read(n) or b"{}"
+                try:
+                    body = json.loads(raw)
+                except json.JSONDecodeError as e:
+                    raise ValueError(f"malformed JSON body: {e}")
+                if not isinstance(body, dict):
+                    raise ValueError(
+                        f"JSON body must be an object, got "
+                        f"{type(body).__name__}")
                 if "prompt" in body:
                     prompt = np.asarray(body["prompt"], np.int32)
                 elif "text" in body and char_level:
@@ -176,24 +275,67 @@ def main(argv=None) -> int:
                     eos_token=(None if body.get("eos_token") is None
                                else int(body["eos_token"])),
                     seed=int(body.get("seed", 0)))
-            except (ValueError, KeyError, TypeError,
-                    json.JSONDecodeError) as e:
+                # body field wins over the X-Deadline-S header; both win
+                # over the server-wide default
+                deadline = body.get("deadline_s",
+                                    self.headers.get("X-Deadline-S"))
+                deadline = (default_deadline if deadline is None
+                            else float(deadline))
+            except (ValueError, KeyError, TypeError) as e:
                 self._reply(400, {"error": str(e)})
                 return
+            except OSError as e:      # serve.http injected IO fault
+                self._reply(503, {"error": f"{type(e).__name__}: {e}"},
+                            retry_after_s=1.0)
+                return
             try:
-                req = sched.submit(prompt, sp, timeout=30.0)
+                req = sched.submit(prompt, sp, timeout=30.0,
+                                   deadline_s=deadline)
+            except AdmissionRejectedError as e:
+                self._reply(429, {"error": str(e)},
+                            retry_after_s=e.retry_after_s)
+                return
             except QueueFullError as e:
-                self._reply(429, {"error": str(e)})
+                self._reply(429, {"error": str(e)}, retry_after_s=2.0)
                 return
-            except (RuntimeError, ValueError) as e:
-                # shutting down, or a prompt the KV cache can't fit
-                self._reply(503 if "shutting down" in str(e) else 400,
-                            {"error": str(e)})
+            except SchedulerClosedError as e:
+                self._reply(503, {"error": str(e)}, retry_after_s=10.0)
                 return
+            except ValueError as e:
+                # a prompt the KV cache can't fit, bad sampling params
+                self._reply(400, {"error": str(e)})
+                return
+            except OSError as e:      # serve.admit injected IO fault
+                self._reply(503, {"error": f"{type(e).__name__}: {e}"},
+                            retry_after_s=1.0)
+                return
+            # the handler's own wait honors the request deadline: even if
+            # the driver is wedged (the watchdog will reap it), the
+            # client gets its typed answer within deadline + grace
+            wait_s = request_timeout
+            if deadline is not None:
+                wait_s = min(wait_s, deadline + 5.0)
             try:
-                tokens = req.result(timeout=args.request_timeout)
+                tokens = req.result(timeout=wait_s)
+            except DeadlineExceededError as e:
+                self._reply(504, {"error": str(e),
+                                  "tokens_before_deadline":
+                                  len(req.tokens)})
+                return
             except TimeoutError as e:
                 self._reply(504, {"error": str(e)})
+                return
+            except (EngineFailedError, SlotQuarantinedError,
+                    SchedulerClosedError) as e:
+                self._reply(503, {"error": f"{type(e).__name__}: {e}"},
+                            retry_after_s=2.0)
+                return
+            except OSError as e:
+                # a request failed by an IO fault (e.g. serve.prefill
+                # oserror) stores that exception; it must surface as a
+                # typed 503, not escape the handler as a traceback
+                self._reply(503, {"error": f"{type(e).__name__}: {e}"},
+                            retry_after_s=1.0)
                 return
             except RuntimeError as e:
                 self._reply(503, {"error": str(e)})
@@ -206,20 +348,59 @@ def main(argv=None) -> int:
                 out["text"] = decode_text(tokens)
             self._reply(200, out)
 
-    httpd = ThreadingHTTPServer((args.host, args.port), Handler)
+    httpd = ThreadingHTTPServer((host, port), Handler)
     # answered-before-closed: server_close waits for handler threads, so
     # every accepted request gets its JSON reply before the process exits
     httpd.daemon_threads = False
     httpd.block_on_close = True
+    sup.start()
+    return ServerHandle(httpd=httpd, scheduler=sched, supervisor=sup,
+                        metrics=metrics, engine_factory=engine_factory,
+                        info=info)
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.device == "cpu":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    from ..utils.checkpoint import CheckpointNotFoundError
+    from ..utils.resilience import dump_thread_stacks
+    from .load import load_for_serving
+
+    try:
+        params, cfg, info = load_for_serving(
+            args.ckpt, step=args.step, config_path=args.config)
+    except (CheckpointNotFoundError, FileNotFoundError, ValueError) as e:
+        print(f"gym_tpu.serve: cannot load {args.ckpt}: {e}",
+              file=sys.stderr)
+        return 1
+    print(f"gym_tpu.serve: restored step {info['step']} "
+          f"({info['num_nodes']}-node average) from {args.ckpt}",
+          flush=True)
+
+    stop = threading.Event()
+    handle = create_server(
+        params, cfg, host=args.host, port=args.port,
+        num_slots=args.num_slots, decode_chunk=args.decode_chunk,
+        max_queue=args.max_queue, request_timeout=args.request_timeout,
+        default_deadline=getattr(args, "default_deadline"),
+        dispatch_timeout=getattr(args, "dispatch_timeout"),
+        max_restarts=getattr(args, "max_restarts"),
+        metrics_dir=args.metrics_dir or os.path.join(args.ckpt, "serve"),
+        info=info, stop_event=stop)
+    httpd, sched, sup, metrics = (handle.httpd, handle.scheduler,
+                                  handle.supervisor, handle.metrics)
 
     def graceful(signum):
         name = signal.Signals(signum).name
         print(f"gym_tpu.serve: {name} — draining "
               f"(answer in-flight, fail queued)", flush=True)
         deadline = getattr(args, "drain_deadline")
-        stop.set()               # driver loop exits after its round
-        loop.join(timeout=deadline)
-        if loop.is_alive():
+        stop.set()
+        if not sup.stop(join_timeout_s=deadline):
             # the driver never came back within the drain deadline (a
             # wedged dispatch, not a slow one): do NOT touch the engine
             # from this thread — it is single-driver by contract and a
@@ -230,6 +411,10 @@ def main(argv=None) -> int:
                 "gym_tpu.serve: driver loop wedged past the "
                 f"{deadline:.0f}s drain deadline:"),
                 file=sys.stderr, flush=True)
+            # still fail queued + in-flight typed (flag writes only, no
+            # engine stepping) so blocked handlers get their answer and
+            # block_on_close can finish
+            sched.shutdown(finish_running=False, deadline_s=0.0)
         else:
             # shutdown() steps the engine itself until running slots
             # finish — safe now that the driver thread has exited
@@ -247,8 +432,9 @@ def main(argv=None) -> int:
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, _on_signal)
 
-    print(f"gym_tpu.serve: listening on http://{args.host}:{args.port} "
-          f"({args.num_slots} slots, queue {args.max_queue})", flush=True)
+    print(f"gym_tpu.serve: listening on http://{args.host}:{handle.port} "
+          f"({args.num_slots} slots, queue {args.max_queue}, watchdog "
+          f"{getattr(args, 'dispatch_timeout'):.0f}s)", flush=True)
     try:
         httpd.serve_forever()
     finally:
@@ -257,7 +443,10 @@ def main(argv=None) -> int:
         head = metrics.headline()
         print(f"gym_tpu.serve: shut down cleanly — "
               f"{head['requests_done']} done, "
-              f"{head['requests_failed']} failed, "
+              f"{head['requests_failed']} failed "
+              f"({head['requests_shed']} shed, "
+              f"{head['requests_quarantined']} quarantined), "
+              f"{head['engine_restarts']} engine restart(s), "
               f"tokens_per_s={head['tokens_per_s']}", flush=True)
         metrics.close()
     return 0

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.nanogpt import GPT, GPTConfig, decode_config, sample_logits
+from ..utils.resilience import fault_point
 
 PyTree = Any
 
@@ -65,11 +66,14 @@ class SamplingParams:
 
 @dataclasses.dataclass
 class TokenEvent:
-    """One generated token, as seen by the scheduler."""
+    """One generated token, as seen by the scheduler. ``poisoned`` marks
+    a token from a quarantined slot (non-finite logits): the value is
+    garbage and the scheduler must fail the request, not deliver it."""
 
     slot: int
     token: int
     finished: bool
+    poisoned: bool = False
 
 
 @dataclasses.dataclass
@@ -81,6 +85,7 @@ class EngineStats:
     prefill_buckets: Tuple[int, ...] = ()
     active_slots: int = 0
     num_slots: int = 0
+    quarantined: int = 0                 # slots shut down on NaN/Inf logits
 
 
 def prompt_bucket(n: int, block_size: int) -> int:
@@ -308,6 +313,7 @@ class InferenceEngine:
             raise RuntimeError("no free slot — admit() requires one "
                                "(scheduler bug: check free_slots() first)")
         slot = free[0]
+        fault_point("serve.prefill")
         n = len(prompt)
         bucket = prompt_bucket(n, self.block_size)
         self._seen_buckets.add(bucket)
@@ -386,6 +392,9 @@ class InferenceEngine:
                 prog = self._step1_prog
         if not self._active.any():
             return []
+        # hit-counted AFTER the idle early-out so hit N is the Nth REAL
+        # decode dispatch — "hang at dispatch 2" reproduces exactly
+        fault_point("serve.decode")
         was_active = self._active.copy()
         remaining = (self._max_new - self._generated).astype(np.int32)
         toks, emitted, lg, final_tok, final_active, cache = prog(
@@ -401,6 +410,22 @@ class InferenceEngine:
         self.last_logits = np.asarray(lg)
         self._next_tok = np.asarray(final_tok).astype(np.int32).copy()
         self._active = np.asarray(final_active).copy()
+        # numerical quarantine: non-finite logits fail ONLY their own
+        # slot — the model's per-row cache math keeps rows isolated (and
+        # _decode_attend NaN-poisons an overflowing row on purpose, so
+        # this is the designated catch point). The check reads the LAST
+        # scanned step's logits for every slot that emitted ANYWHERE in
+        # this chunk: a poisoned slot that hits max-tokens mid-chunk
+        # goes inactive, but its final-step logits still flow from the
+        # NaN K/V in its cache rows, so the poison stays visible (NaN
+        # never compares equal to EOS, so EOS can't self-evict it
+        # either). Slots inactive for the whole chunk are excluded —
+        # their garbage compute quarantines no one.
+        bad = emitted.any(axis=0) & ~np.isfinite(self.last_logits).all(
+            axis=1)
+        for slot in np.nonzero(bad)[0]:
+            self._active[slot] = False           # quarantine = evict
+            self.stats.quarantined += 1
         events: List[TokenEvent] = []
         n_steps = toks.shape[0]
         for k in range(n_steps):
@@ -412,7 +437,8 @@ class InferenceEngine:
                 # (its last emitted step) and it came back inactive
                 last_emit = not emitted[k + 1:, slot].any()
                 finished = bool(last_emit and not self._active[slot])
-                events.append(TokenEvent(int(slot), tok, finished))
+                events.append(TokenEvent(int(slot), tok, finished,
+                                         poisoned=bool(bad[slot])))
         self.stats.tokens_generated += len(events)
         self.stats.decode_steps += int(was_active.any()) * n_steps
         self.stats.active_slots = int(self._active.sum())

@@ -6,12 +6,26 @@ kinds share the header:
 
 - ``request`` — one row per completed/failed request: TTFT, new-token
   count, mean per-token latency, and the queue/slot state at completion.
+  The ``status`` column types the outcome: ``done``, ``failed``,
+  ``shed`` (deadline elapsed — queued shed or running cancelled),
+  ``quarantined`` (NaN/Inf logits in the slot), ``rejected`` (admission
+  control turned it away before it was ever enqueued).
 - ``engine``  — a periodic engine sample (every ``engine_log_every``
   ticks of the driver loop): cumulative tokens, rolling tokens/s, queue
-  depth, active-slot occupancy.
+  depth, active-slot occupancy. ``status=restart`` marks a supervisor
+  engine rebuild.
+
+Beyond the counters, the collector maintains a tokens/s EWMA over driver
+ticks — the live service-rate estimate ``Scheduler.submit`` uses for
+admission control — and p50/p95/p99 percentiles of TTFT and per-token
+latency (tail latency is the serving observable; a mean hides a wedged
+tail completely).
 
 ``headline()`` aggregates the run into the one-line JSON surface
-``bench.py --serve-only`` and the HTTP ``/stats`` endpoint report.
+``bench.py --serve-only`` / ``--chaos-only`` and the HTTP ``/stats``
+endpoint report; ``read_headline(path)`` recomputes the same aggregate
+from a ``serve.csv`` on disk (post-hoc analysis, tests on synthetic
+files).
 """
 
 from __future__ import annotations
@@ -20,7 +34,10 @@ import csv
 import os
 import threading
 import time
-from typing import Any, Dict
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
 
 HEADER = [
     "ts_s", "kind", "request_id", "status", "queue_depth", "active_slots",
@@ -28,9 +45,45 @@ HEADER = [
     "cum_tokens", "tokens_per_s",
 ]
 
+#: EWMA smoothing for the live tokens/s estimate (per driver tick with
+#: token progress). 0.2 ≈ a ~5-tick memory: reactive enough to track a
+#: fault-induced slowdown, smooth enough not to flap admission control.
+EWMA_ALPHA = 0.2
+
+#: A fully idle engine (no active slots, empty queue, no token flow) for
+#: this long resets the EWMA to None — cold again, admission turns
+#: optimistic. Without this, a transient-slowdown rate measured before an
+#: idle period would keep rejecting deadline'd requests forever: rejected
+#: requests generate no tokens, so a stale-low EWMA could never refresh.
+EWMA_IDLE_RESET_S = 10.0
+
+#: Tail-latency sample window. Serving runs are unbounded; percentiles
+#: over the last N requests keep memory flat and the numbers current.
+PERCENTILE_WINDOW = 10_000
+
+_PCTS = (50, 95, 99)
+
+
+def _percentiles(samples, prefix: str) -> Dict[str, Optional[float]]:
+    out: Dict[str, Optional[float]] = {}
+    arr = np.asarray([s for s in samples if s is not None], np.float64)
+    for p in _PCTS:
+        out[f"{prefix}_p{p}_s"] = (
+            round(float(np.percentile(arr, p)), 5) if arr.size else None)
+    return out
+
+
+#: request-failure exception class → serve.csv status value. Typed by
+#: NAME so metrics stays import-decoupled from the scheduler.
+_STATUS_BY_EXC = {
+    "DeadlineExceededError": "shed",
+    "SlotQuarantinedError": "quarantined",
+}
+
 
 class ServeMetrics:
-    def __init__(self, out_dir: str, engine_log_every: int = 50):
+    def __init__(self, out_dir: str, engine_log_every: int = 50,
+                 ewma_idle_reset_s: float = EWMA_IDLE_RESET_S):
         os.makedirs(out_dir, exist_ok=True)
         self.path = os.path.join(out_dir, "serve.csv")
         # append, not "w": a server restart over the same run dir must
@@ -48,11 +101,22 @@ class ServeMetrics:
         self._ticks = 0
         self.requests_done = 0
         self.requests_failed = 0
+        self.requests_shed = 0
+        self.requests_quarantined = 0
+        self.requests_rejected = 0
+        self.engine_restarts = 0
         self.tokens_out = 0
         self._ttft_sum = 0.0
         self._ttft_n = 0
         self._lat_sum = 0.0
         self._lat_n = 0
+        self._ttfts: deque = deque(maxlen=PERCENTILE_WINDOW)
+        self._lats: deque = deque(maxlen=PERCENTILE_WINDOW)
+        self._ewma: Optional[float] = None
+        self._ewma_last_tok = 0
+        self._ewma_last_t: Optional[float] = None
+        self._ewma_idle_reset_s = float(ewma_idle_reset_s)
+        self._idle_since: Optional[float] = None
 
     def _now(self) -> float:
         return time.perf_counter() - self._t0
@@ -60,21 +124,31 @@ class ServeMetrics:
     def request_done(self, req, queue_depth: int,
                      active_slots: int) -> None:
         with self._lock:
+            if self._f.closed:        # straggler after close(): drop it
+                return
             failed = req.error is not None
+            status = "done"
+            if failed:
+                status = _STATUS_BY_EXC.get(
+                    type(req.exception).__name__, "failed")
             self.requests_failed += int(failed)
             self.requests_done += int(not failed)
+            self.requests_shed += int(status == "shed")
+            self.requests_quarantined += int(status == "quarantined")
             self.tokens_out += len(req.tokens)
             ttft = req.ttft_s
             lat = req.avg_token_latency_s
             if ttft is not None:
                 self._ttft_sum += ttft
                 self._ttft_n += 1
+                self._ttfts.append(ttft)
             if lat is not None:
                 self._lat_sum += lat
                 self._lat_n += 1
+                self._lats.append(lat)
             self._w.writerow([
-                f"{self._now():.4f}", "request", req.id,
-                "failed" if failed else "done", queue_depth, active_slots,
+                f"{self._now():.4f}", "request", req.id, status,
+                queue_depth, active_slots,
                 int(req.prompt.size), len(req.tokens),
                 "" if ttft is None else f"{ttft:.5f}",
                 "" if lat is None else f"{lat:.5f}",
@@ -82,16 +156,76 @@ class ServeMetrics:
             ])
             self._f.flush()
 
-    def engine_tick(self, stats, queue_depth: int) -> None:
-        """Sampled engine row — call once per driver-loop round; writes
-        every ``engine_log_every``-th call so an idle server doesn't grow
-        the CSV unboundedly."""
+    def request_rejected(self, queue_depth: int,
+                         active_slots: int) -> None:
+        """Admission control shed a request before it was enqueued (no
+        Request object ever existed — the whole point)."""
         with self._lock:
+            if self._f.closed:
+                return
+            self.requests_rejected += 1
+            self._w.writerow([
+                f"{self._now():.4f}", "request", "", "rejected",
+                queue_depth, active_slots, "", "", "", "",
+                self.tokens_out, f"{self.tokens_per_s():.2f}",
+            ])
+            self._f.flush()
+
+    def engine_restarted(self) -> None:
+        """A supervisor failover rebuilt the engine."""
+        with self._lock:
+            if self._f.closed:
+                return
+            self.engine_restarts += 1
+            self._w.writerow([
+                f"{self._now():.4f}", "engine", "", "restart", "", "",
+                "", "", "", "", self.tokens_out,
+                f"{self.tokens_per_s():.2f}",
+            ])
+            self._f.flush()
+
+    def engine_tick(self, stats, queue_depth: int) -> None:
+        """Per-driver-round sample. ALWAYS updates the tokens/s EWMA
+        (admission control reads it live); writes a CSV row only every
+        ``engine_log_every``-th call so an idle server doesn't grow the
+        CSV unboundedly."""
+        with self._lock:
+            if self._f.closed:
+                # a straggler driver thread may tick after close() — the
+                # sample is worthless, the crash would not be
+                return
+            now = self._now()
+            tok = int(stats.tokens_generated)
+            if self._ewma_last_t is not None:
+                d_tok = tok - self._ewma_last_tok
+                d_t = now - self._ewma_last_t
+                # d_tok < 0 = the engine was rebuilt (counter reset):
+                # re-anchor, keep the old EWMA — the rate estimate
+                # survives a supervisor failover
+                if d_tok > 0 and d_t > 0:
+                    inst = d_tok / d_t
+                    self._ewma = (inst if self._ewma is None else
+                                  EWMA_ALPHA * inst
+                                  + (1.0 - EWMA_ALPHA) * self._ewma)
+                    self._idle_since = None
+                elif int(stats.active_slots) == 0 and queue_depth == 0:
+                    # fully idle: after a while the old rate says nothing
+                    # about the next request — go cold (optimistic admit)
+                    # rather than reject on a stale-low estimate. A
+                    # BUSY-but-stalled engine keeps its honest low rate.
+                    if self._idle_since is None:
+                        self._idle_since = now
+                    elif (now - self._idle_since >= self._ewma_idle_reset_s
+                          and self._ewma is not None):
+                        self._ewma = None
+                else:
+                    self._idle_since = None
+            self._ewma_last_tok, self._ewma_last_t = tok, now
             self._ticks += 1
             if self._ticks % self._every:
                 return
             self._w.writerow([
-                f"{self._now():.4f}", "engine", "", "", queue_depth,
+                f"{now:.4f}", "engine", "", "", queue_depth,
                 stats.active_slots, "", "", "", "",
                 stats.tokens_generated, f"{self.tokens_per_s():.2f}",
             ])
@@ -100,20 +234,35 @@ class ServeMetrics:
         dt = self._now()
         return self.tokens_out / dt if dt > 0 else 0.0
 
+    def tokens_per_s_ewma(self) -> Optional[float]:
+        """Live service-rate estimate (None until the first productive
+        tick) — the admission-control input."""
+        with self._lock:
+            return self._ewma
+
     def headline(self) -> Dict[str, Any]:
         with self._lock:
-            return {
+            head = {
                 "requests_done": self.requests_done,
                 "requests_failed": self.requests_failed,
+                "requests_shed": self.requests_shed,
+                "requests_quarantined": self.requests_quarantined,
+                "requests_rejected": self.requests_rejected,
+                "engine_restarts": self.engine_restarts,
                 "tokens_out": self.tokens_out,
                 "wall_s": round(self._now(), 3),
                 "tokens_per_s": round(self.tokens_per_s(), 2),
+                "tokens_per_s_ewma": (round(self._ewma, 2)
+                                      if self._ewma is not None else None),
                 "mean_ttft_s": (round(self._ttft_sum / self._ttft_n, 5)
                                 if self._ttft_n else None),
                 "mean_token_latency_s": (
                     round(self._lat_sum / self._lat_n, 5)
                     if self._lat_n else None),
             }
+            head.update(_percentiles(self._ttfts, "ttft"))
+            head.update(_percentiles(self._lats, "token_lat"))
+            return head
 
     def sync(self) -> None:
         with self._lock:
@@ -124,3 +273,53 @@ class ServeMetrics:
         with self._lock:
             self._f.flush()
             self._f.close()
+
+
+def read_headline(path: str) -> Dict[str, Any]:
+    """Recompute the aggregate headline from a ``serve.csv`` on disk —
+    the same counters and percentiles ``ServeMetrics.headline`` reports
+    live, derived post-hoc from the request rows (so a finished run, a
+    synthetic fixture, or another process's CSV all aggregate the same
+    way). Engine rows contribute only ``engine_restarts``."""
+    counts = {"done": 0, "failed": 0, "shed": 0, "quarantined": 0,
+              "rejected": 0}
+    restarts = 0
+    tokens_out = 0
+    last_ts = 0.0
+    ttfts: List[float] = []
+    lats: List[float] = []
+    with open(path, newline="") as f:
+        for row in csv.DictReader(f):
+            last_ts = max(last_ts, float(row["ts_s"] or 0.0))
+            if row["kind"] == "engine":
+                restarts += int(row["status"] == "restart")
+                continue
+            if row["kind"] != "request":
+                continue
+            status = row["status"]
+            if status in counts:
+                counts[status] += 1
+            tokens_out += int(row["new_tokens"] or 0)
+            if row["ttft_s"]:
+                ttfts.append(float(row["ttft_s"]))
+            if row["avg_token_latency_s"]:
+                lats.append(float(row["avg_token_latency_s"]))
+    failed = (counts["failed"] + counts["shed"] + counts["quarantined"])
+    head: Dict[str, Any] = {
+        "requests_done": counts["done"],
+        "requests_failed": failed,
+        "requests_shed": counts["shed"],
+        "requests_quarantined": counts["quarantined"],
+        "requests_rejected": counts["rejected"],
+        "engine_restarts": restarts,
+        "tokens_out": tokens_out,
+        "wall_s": round(last_ts, 3),
+        "tokens_per_s": round(tokens_out / last_ts, 2) if last_ts else 0.0,
+        "mean_ttft_s": (round(sum(ttfts) / len(ttfts), 5)
+                        if ttfts else None),
+        "mean_token_latency_s": (round(sum(lats) / len(lats), 5)
+                                 if lats else None),
+    }
+    head.update(_percentiles(ttfts, "ttft"))
+    head.update(_percentiles(lats, "token_lat"))
+    return head

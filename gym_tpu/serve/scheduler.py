@@ -6,14 +6,31 @@ The engine (``engine.py``) knows slots; this layer knows REQUESTS:
   full it blocks up to ``timeout`` for a drain (or raises
   ``QueueFullError`` immediately with ``block=False``). Requests that
   can never fit the KV cache are rejected at submit time with the same
-  typed ``ValueError`` ``generate_fast`` raises.
+  typed ``ValueError`` ``generate_fast`` raises. Requests that carry a
+  ``deadline_s`` the engine provably cannot meet — estimated from the
+  live tokens/s EWMA and the current backlog — are rejected typed
+  (``AdmissionRejectedError``, with a ``retry_after_s`` hint) instead of
+  being enqueued to time out: admission control / load shedding.
 - ``step``: one scheduling round, run by the single driver thread:
-  admit queued requests into free slots (prefill), advance every active
+  shed queued requests past their deadline (before prefill), admit
+  queued requests into free slots (prefill), advance every active
   slot one token (the shared decode step), and complete/evict finished
-  requests BETWEEN steps — continuous batching.
+  requests BETWEEN steps — continuous batching. Running requests past
+  their deadline are cancelled at the chunk boundary and their slot
+  freed; a slot the engine quarantined (NaN/Inf logits) fails only its
+  own request.
 - ``Request``: the poll/wait surface — status, accumulated tokens, and a
-  ``result(timeout)`` future; per-request TTFT/latency timestamps feed
-  ``metrics.ServeMetrics``.
+  ``result(timeout)`` future; per-request TTFT/latency stamps feed
+  ``metrics.ServeMetrics``. Failures carry their TYPED exception
+  (``Request.exception``), which ``result`` re-raises — callers branch
+  on class, not on string matching.
+
+Engine failover (``supervisor.Supervisor``) uses two hooks:
+``fail_inflight`` (fail every running request typed, bump the scheduler
+EPOCH) and ``replace_engine``. The epoch makes failover safe against a
+WEDGED driver thread: a stale ``step`` that finally wakes from a hung
+dispatch finds the epoch advanced and discards its admissions and
+events instead of corrupting the rebuilt engine's slot bookkeeping.
 """
 
 from __future__ import annotations
@@ -21,19 +38,56 @@ from __future__ import annotations
 import dataclasses
 import enum
 import itertools
+import sys
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
+from ..utils.resilience import fault_point
 from .engine import InferenceEngine, SamplingParams
 
 
 class QueueFullError(RuntimeError):
     """Backpressure signal: the FCFS queue is at capacity and the caller
     declined (or timed out) waiting for it to drain."""
+
+
+class SchedulerClosedError(RuntimeError):
+    """Typed "scheduler is shutting down": raised by ``submit`` after
+    ``shutdown()`` and stored on requests failed by the drain. Subclasses
+    ``RuntimeError`` so pre-existing callers that caught the bare
+    ``RuntimeError`` keep working."""
+
+
+class DeadlineExceededError(RuntimeError):
+    """The request's ``deadline_s`` elapsed: shed from the queue before
+    prefill, or cancelled at a decode-chunk boundary while running."""
+
+
+class AdmissionRejectedError(RuntimeError):
+    """Load shedding at ``submit``: the live tokens/s EWMA says this
+    request cannot finish inside its ``deadline_s``, so it is rejected
+    up front instead of queued to die. ``retry_after_s`` estimates when
+    the current backlog will have drained."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
+class EngineFailedError(RuntimeError):
+    """The engine crashed or wedged under this request: its in-flight
+    generation cannot be recovered (the KV cache died with the engine).
+    The supervisor rebuilds the engine; RETRYING the request is safe."""
+
+
+class SlotQuarantinedError(RuntimeError):
+    """The engine detected non-finite (NaN/Inf) logits in this request's
+    slot and quarantined it — only this request fails; neighbor slots
+    are row-isolated by the model's per-row cache math."""
 
 
 class RequestStatus(enum.Enum):
@@ -51,9 +105,11 @@ class Request:
     id: int
     prompt: np.ndarray
     sampling: SamplingParams
+    deadline_s: Optional[float] = None
     status: RequestStatus = RequestStatus.QUEUED
     tokens: List[int] = dataclasses.field(default_factory=list)
     error: Optional[str] = None
+    exception: Optional[BaseException] = None
     submit_t: float = 0.0
     first_token_t: Optional[float] = None
     done_t: Optional[float] = None
@@ -62,13 +118,25 @@ class Request:
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
         """Block until the request completes; returns the new tokens or
-        raises ``RuntimeError`` (failed) / ``TimeoutError``."""
+        raises the TYPED failure (``DeadlineExceededError``,
+        ``EngineFailedError``, ``SlotQuarantinedError``,
+        ``SchedulerClosedError`` — all ``RuntimeError`` subclasses) /
+        ``TimeoutError``."""
         if not self._event.wait(timeout):
             raise TimeoutError(f"request {self.id} still "
                                f"{self.status.value} after {timeout}s")
         if self.status is RequestStatus.FAILED:
+            if self.exception is not None:
+                raise self.exception
             raise RuntimeError(f"request {self.id} failed: {self.error}")
         return list(self.tokens)
+
+    @property
+    def deadline_t(self) -> Optional[float]:
+        """Absolute ``perf_counter`` deadline (None = no deadline)."""
+        if self.deadline_s is None:
+            return None
+        return self.submit_t + self.deadline_s
 
     @property
     def ttft_s(self) -> Optional[float]:
@@ -101,35 +169,94 @@ class Scheduler:
         self._by_slot: Dict[int, Request] = {}
         self._ids = itertools.count()
         self._accepting = True
+        self._shutdown_done = False
+        self._epoch = 0
+        # queued requests carrying a deadline — lets the per-step shed
+        # sweep early-out to one integer check in the (common)
+        # no-deadline deployment instead of an O(queue) scan
+        self._queued_deadlines = 0
+        # the request popped from the queue but not yet placed in
+        # _by_slot (the driver is inside engine.admit): failover and
+        # shutdown must be able to fail it too — it is in NEITHER
+        # collection while the prefill runs
+        self._admitting: Optional[Request] = None
 
     # -- submit side ------------------------------------------------------
 
+    def _estimate_service_s(self, max_new: int) -> Optional[float]:
+        """Seconds until a request submitted NOW would finish, from the
+        live tokens/s EWMA (``metrics``) and the tokens already committed
+        ahead of it (queued max_new + remaining of running). Aggregate
+        rate over total pending tokens is the right model for a slot
+        batch: the engine serves the whole backlog concurrently at the
+        EWMA rate. ``None`` when no rate is established yet (cold
+        engine) — admission is then optimistic and the deadline is
+        enforced downstream by shedding/cancellation."""
+        if self.metrics is None:
+            return None
+        rate = self.metrics.tokens_per_s_ewma()
+        if rate is None or rate <= 0:
+            return None
+        backlog = sum(r.sampling.max_new_tokens for r in self._queue)
+        backlog += sum(
+            max(0, r.sampling.max_new_tokens - len(r.tokens))
+            for r in self._by_slot.values())
+        return (backlog + max_new) / rate
+
     def submit(self, prompt, sampling: Optional[SamplingParams] = None,
-               block: bool = True,
-               timeout: Optional[float] = 30.0) -> Request:
+               block: bool = True, timeout: Optional[float] = 30.0,
+               deadline_s: Optional[float] = None) -> Request:
+        fault_point("serve.admit")
+        t_entry = time.perf_counter()
         sampling = sampling or SamplingParams()
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.engine.validate(prompt, sampling)   # typed ValueError, early
-        deadline = None if timeout is None else time.perf_counter() + timeout
+        if deadline_s is not None and not deadline_s > 0:
+            raise ValueError(
+                f"deadline_s must be > 0 (got {deadline_s}); omit it for "
+                f"no deadline")
+        # the deadline clock starts at submit ENTRY and also caps the
+        # queue-full blocking wait — "bounds the request end to end"
+        # must include time spent waiting for queue space
+        cap = timeout
+        if deadline_s is not None:
+            cap = deadline_s if cap is None else min(cap, deadline_s)
+        wait_deadline = None if cap is None else t_entry + cap
         with self._drained:
             if not self._accepting:
-                raise RuntimeError("scheduler is shutting down")
+                raise SchedulerClosedError("scheduler is shutting down")
+            if deadline_s is not None:
+                est = self._estimate_service_s(sampling.max_new_tokens)
+                if est is not None and est > deadline_s:
+                    if self.metrics is not None:
+                        self.metrics.request_rejected(
+                            queue_depth=len(self._queue),
+                            active_slots=self.engine.stats.active_slots)
+                    raise AdmissionRejectedError(
+                        f"deadline_s={deadline_s:.3g} infeasible: estimated "
+                        f"service time {est:.3g}s at the current "
+                        f"{self.metrics.tokens_per_s_ewma() or 0.0:.1f} "
+                        f"tok/s — shed at admission",
+                        retry_after_s=max(0.1, est - deadline_s))
             while len(self._queue) >= self.max_queue:
                 if not block:
                     raise QueueFullError(
                         f"request queue at capacity ({self.max_queue})")
-                rem = None if deadline is None \
-                    else deadline - time.perf_counter()
+                rem = None if wait_deadline is None \
+                    else wait_deadline - time.perf_counter()
                 if rem is not None and rem <= 0:
                     raise QueueFullError(
                         f"request queue still at capacity "
-                        f"({self.max_queue}) after {timeout}s")
+                        f"({self.max_queue}) after {cap}s")
                 self._drained.wait(rem)
                 if not self._accepting:
-                    raise RuntimeError("scheduler is shutting down")
+                    raise SchedulerClosedError("scheduler is shutting down")
             req = Request(id=next(self._ids), prompt=prompt,
-                          sampling=sampling, submit_t=time.perf_counter())
+                          sampling=sampling, deadline_s=deadline_s,
+                          submit_t=t_entry)
             self._queue.append(req)
+            if deadline_s is not None:
+                self._queued_deadlines += 1
         return req
 
     def queue_depth(self) -> int:
@@ -142,67 +269,203 @@ class Scheduler:
 
     # -- driver side ------------------------------------------------------
 
-    def _admit_from_queue(self) -> int:
+    def _shed_expired_queued(self, now: float) -> List[Request]:
+        """Remove queued requests whose deadline already passed — shed
+        BEFORE prefill, even when every slot is busy (an expired request
+        must not wait for a free slot just to be told it is late)."""
+        shed: List[Request] = []
+        with self._drained:
+            if not self._queued_deadlines:
+                return shed
+            keep = deque()
+            for req in self._queue:
+                dl = req.deadline_t
+                if dl is not None and now > dl:
+                    shed.append(req)
+                else:
+                    keep.append(req)
+            if shed:
+                self._queue = keep
+                self._queued_deadlines -= len(shed)
+                self._drained.notify_all()
+        return shed
+
+    def _admit_from_queue(self, epoch: int,
+                          engine: InferenceEngine) -> int:
         admitted = 0
-        while self.engine.free_slots():
+        while engine.free_slots():
             with self._drained:
-                if not self._queue:
+                if self._epoch != epoch or not self._queue:
                     break
                 req = self._queue.popleft()
+                if req.deadline_s is not None:
+                    self._queued_deadlines -= 1
+                self._admitting = req
                 self._drained.notify_all()
+            dl = req.deadline_t
+            if dl is not None and time.perf_counter() > dl:
+                # expired between the shed sweep and this pop
+                with self._lock:
+                    if self._admitting is req:
+                        self._admitting = None
+                self._fail(req, DeadlineExceededError(
+                    f"deadline_s={req.deadline_s:.3g} elapsed in queue — "
+                    f"shed before prefill"))
+                continue
             try:
-                slot, ev = self.engine.admit(req.prompt, req.sampling)
+                slot, ev = engine.admit(req.prompt, req.sampling)
             except Exception as e:  # noqa: BLE001 — a bad request must
                 # fail ITSELF, not tear the serving loop down
-                self._fail(req, f"{type(e).__name__}: {e}")
+                with self._lock:
+                    if self._admitting is req:
+                        self._admitting = None
+                self._fail(req, e)
                 continue
-            req.status = RequestStatus.RUNNING
-            req.first_token_t = time.perf_counter()
-            req.tokens.append(ev.token)
-            admitted += 1
+            with self._lock:
+                # clear only OUR marker: a stale waking driver must not
+                # wipe the live generation's in-admission request
+                if self._admitting is req:
+                    self._admitting = None
+                stale = self._epoch != epoch
+                # a failover/shutdown may have failed this request while
+                # we were inside admit — never resurrect a resolved one
+                resolved = req.status in (RequestStatus.DONE,
+                                          RequestStatus.FAILED)
+                if not stale and not resolved:
+                    req.status = RequestStatus.RUNNING
+                    req.first_token_t = time.perf_counter()
+                    req.tokens.append(ev.token)
+                    admitted += 1
+                    if not ev.finished:
+                        self._by_slot[slot] = req
+            if resolved and not stale:
+                engine.release(slot)   # same engine; free the row
+                continue
+            if stale:
+                # the engine was replaced mid-admit (supervisor failover):
+                # this prefill went into the DEAD engine
+                self._fail(req, EngineFailedError(
+                    "engine replaced during admission (supervisor "
+                    "failover) — retry"))
+                break
             if ev.finished:
                 self._complete(req)
-            else:
-                self._by_slot[slot] = req
         return admitted
 
     def step(self) -> int:
         """One scheduling round; returns the number of tokens produced
         (0 = idle). Admission happens BEFORE the decode step so a freed
-        slot turns around within one round."""
-        produced = self._admit_from_queue()
-        events = self.engine.step()
+        slot turns around within one round. Epoch-guarded: a stale driver
+        (one that wedged, was failed over past, and finally woke) discards
+        its events instead of touching the rebuilt engine's requests."""
+        now0 = time.perf_counter()
+        for req in self._shed_expired_queued(now0):
+            self._fail(req, DeadlineExceededError(
+                f"deadline_s={req.deadline_s:.3g} elapsed in queue after "
+                f"{now0 - req.submit_t:.3g}s — shed before prefill"))
+        with self._lock:
+            epoch = self._epoch
+            engine = self.engine
+        produced = self._admit_from_queue(epoch, engine)
+        events = engine.step()
         now = time.perf_counter()
-        for ev in events:
-            req = self._by_slot.get(ev.slot)
-            if req is None:      # slot freed by a cancel between steps
-                continue
-            req.tokens.append(ev.token)
-            produced += 1
-            if ev.finished:
-                del self._by_slot[ev.slot]
-                self._complete(req, now)
+        completed: List[Request] = []
+        failed: List[Tuple[Request, BaseException]] = []
+        with self._lock:
+            if self._epoch != epoch:
+                return produced        # stale driver: discard the chunk
+            for ev in events:
+                req = self._by_slot.get(ev.slot)
+                if req is None:      # slot freed by a cancel between steps
+                    continue
+                if ev.poisoned:
+                    # NaN/Inf quarantine: the engine already deactivated
+                    # the slot; this chunk's tokens are garbage — fail
+                    # ONLY this request, drop its events
+                    del self._by_slot[ev.slot]
+                    failed.append((req, SlotQuarantinedError(
+                        f"non-finite logits in slot {ev.slot} — request "
+                        f"quarantined after {len(req.tokens)} tokens")))
+                    continue
+                req.tokens.append(ev.token)
+                produced += 1
+                if ev.finished:
+                    del self._by_slot[ev.slot]
+                    completed.append(req)
+            # deadline cancellation at the chunk boundary: the slot is
+            # freed for the next admit, the partial generation reported
+            for slot, req in list(self._by_slot.items()):
+                dl = req.deadline_t
+                if dl is not None and now > dl:
+                    engine.release(slot)
+                    del self._by_slot[slot]
+                    failed.append((req, DeadlineExceededError(
+                        f"deadline_s={req.deadline_s:.3g} exceeded "
+                        f"mid-generation ({len(req.tokens)} tokens in) — "
+                        f"cancelled at chunk boundary")))
+        for req in completed:
+            self._complete(req, now)
+        for req, exc in failed:
+            self._fail(req, exc)
         return produced
 
     def _complete(self, req: Request,
                   now: Optional[float] = None) -> None:
-        req.done_t = now if now is not None else time.perf_counter()
-        req.status = RequestStatus.DONE
+        with self._lock:   # idempotent: failover/shutdown may race us
+            if req.status in (RequestStatus.DONE, RequestStatus.FAILED):
+                return
+            req.done_t = now if now is not None else time.perf_counter()
+            req.status = RequestStatus.DONE
         req._event.set()
         if self.metrics is not None:
             self.metrics.request_done(
                 req, queue_depth=self.queue_depth(),
                 active_slots=self.engine.stats.active_slots)
 
-    def _fail(self, req: Request, error: str) -> None:
-        req.error = error
-        req.status = RequestStatus.FAILED
-        req.done_t = time.perf_counter()
+    def _fail(self, req: Request,
+              error: Union[str, BaseException]) -> None:
+        with self._lock:   # idempotent: only the FIRST resolution wins
+            if req.status in (RequestStatus.DONE, RequestStatus.FAILED):
+                return
+            if isinstance(error, BaseException):
+                req.exception = error
+                req.error = f"{type(error).__name__}: {error}"
+            else:
+                req.error = error
+            req.status = RequestStatus.FAILED
+            req.done_t = time.perf_counter()
         req._event.set()
         if self.metrics is not None:
             self.metrics.request_done(
                 req, queue_depth=self.queue_depth(),
                 active_slots=self.engine.stats.active_slots)
+
+    # -- failover hooks (supervisor) --------------------------------------
+
+    def fail_inflight(self, error: BaseException) -> List[Request]:
+        """Fail every RUNNING request typed and advance the epoch so a
+        stale (wedged) driver that eventually wakes cannot apply its
+        events or admissions. Called by the supervisor on an engine crash
+        or watchdog-detected wedge; queued requests stay queued — they
+        resume on the rebuilt engine."""
+        with self._drained:
+            self._epoch += 1
+            victims = list(self._by_slot.values())
+            self._by_slot.clear()
+            if self._admitting is not None:
+                # popped from the queue but wedged inside engine.admit —
+                # in NEITHER collection; its future must not wait for
+                # the abandoned thread to wake (maybe never)
+                victims.append(self._admitting)
+        for req in victims:
+            self._fail(req, error)
+        return victims
+
+    def replace_engine(self, engine: InferenceEngine) -> None:
+        """Swap in a rebuilt engine (after ``fail_inflight``). The global
+        program LRUs make the swap warm: same config → no recompiles."""
+        with self._lock:
+            self.engine = engine
 
     def run(self, stop: threading.Event, idle_wait_s: float = 0.005):
         """Drive ``step`` until ``stop`` is set; sleeps briefly when idle
@@ -218,23 +481,47 @@ class Scheduler:
     def shutdown(self, finish_running: bool = True,
                  deadline_s: float = 300.0) -> None:
         """Graceful drain (the SIGTERM path): stop accepting, FAIL queued
-        requests ("shutting down" — reported, not dropped), and either
-        answer every in-flight request (``finish_running=True``, bounded
-        by ``deadline_s``) or fail those too. Call from the driver thread
-        or after the driver loop has stopped."""
+        requests (typed ``SchedulerClosedError`` — reported, not
+        dropped), and either answer every in-flight request
+        (``finish_running=True``, bounded by ``deadline_s``) or fail
+        those too. Call from the driver thread or after the driver loop
+        has stopped. Idempotent: a second call returns immediately
+        instead of re-draining."""
         with self._drained:
+            if self._shutdown_done:
+                return
+            self._shutdown_done = True
             self._accepting = False
             queued = list(self._queue)
             self._queue.clear()
+            self._queued_deadlines = 0
             self._drained.notify_all()
         for req in queued:
-            self._fail(req, "server shutting down before this request "
-                            "was scheduled")
+            self._fail(req, SchedulerClosedError(
+                "server shutting down before this request was scheduled"))
         if finish_running:
             deadline = time.perf_counter() + deadline_s
             while self._by_slot and time.perf_counter() < deadline:
-                self.step()
+                try:
+                    self.step()
+                except Exception as e:  # noqa: BLE001 — a broken engine
+                    # cannot drain (e.g. a persistent fault raced the
+                    # stop); fall through and fail the remainder typed
+                    # instead of killing the drain thread mid-shutdown
+                    sys.stderr.write(
+                        f"gym_tpu.serve: drain step raised "
+                        f"{type(e).__name__}: {e} — failing remaining "
+                        f"in-flight requests\n")
+                    break
         for slot, req in list(self._by_slot.items()):
             self.engine.release(slot)
             del self._by_slot[slot]
-            self._fail(req, "server shut down mid-generation")
+            self._fail(req, SchedulerClosedError(
+                "server shut down mid-generation"))
+        with self._lock:
+            admitting = self._admitting
+        if admitting is not None:
+            # mid-admission under a wedged driver: resolve its future
+            # (idempotent _fail — a no-op if the driver got there first)
+            self._fail(admitting, SchedulerClosedError(
+                "server shut down during admission"))

@@ -29,6 +29,10 @@ Registered fault sites (each lists who fires it):
 ``checkpoint.device_get`` checkpoint writer thread, before the snapshot fetch
 ``prefetch.fill``       ``HostPrefetcher`` worker, before each batch assembly
 ``dispatch.boundary``   Trainer fit loop, top of every dispatch iteration
+``serve.prefill``       ``InferenceEngine.admit``, before the prefill dispatch
+``serve.decode``        ``InferenceEngine.step``, before the decode dispatch
+``serve.admit``         ``Scheduler.submit``, before admission control
+``serve.http``          ``gym_tpu.serve`` HTTP handler, top of ``POST``
 ====================== ====================================================
 
 ``GYM_TPU_FAULTS`` spec: comma-separated ``site:action[=arg][@window]``
@@ -61,6 +65,10 @@ FAULT_SITES = (
     "checkpoint.device_get",
     "prefetch.fill",
     "dispatch.boundary",
+    "serve.prefill",
+    "serve.decode",
+    "serve.admit",
+    "serve.http",
 )
 
 _ACTIONS = ("kill", "sigterm", "oserror", "delay", "hang")

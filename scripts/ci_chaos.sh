@@ -1,0 +1,202 @@
+#!/usr/bin/env bash
+# Chaos gate (ISSUE 5) — serving under fire, run NEXT TO
+# scripts/ci_tier1.sh, ci_faults.sh, ci_sim.sh and ci_serve.sh.
+# Three layers:
+#
+#   1. the chaos unit suite (tests/test_serve_chaos.py): deadline
+#      shedding, EWMA admission control, NaN quarantine, supervisor
+#      crash/wedge recovery, typed HTTP mappings;
+#   2. the serve parity suite RE-RUN under injected latency faults
+#      (GYM_TPU_FAULTS delay on every prefill+decode dispatch): token
+#      streams must stay EXACT under host-side latency chaos;
+#   3. the HTTP chaos smoke through the real `python -m gym_tpu.serve`
+#      entry point with an injected decode HANG: the supervisor must
+#      abandon the wedged driver, fail the in-flight request TYPED
+#      (503, inside its deadline — never a 500), rebuild the engine and
+#      answer the next request; an infeasible deadline must draw
+#      429 + Retry-After; SIGTERM must still exit 0 with a clean
+#      shutdown line.
+#
+# CPU-only; sized for the 2-core container.
+#
+# Usage: scripts/ci_chaos.sh   (from the repo root or anywhere)
+set -o pipefail
+cd "$(dirname "$0")/.."
+REPO="$(pwd)"
+
+rm -f /tmp/_chaos.log
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_serve_chaos.py -q -m 'not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_chaos.log
+rc=${PIPESTATUS[0]}
+echo CHAOS_DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' \
+    /tmp/_chaos.log | tr -cd . | wc -c)
+[ "$rc" -ne 0 ] && exit "$rc"
+
+# Layer 2: the PR-4 parity oracles must hold UNDER latency faults — a
+# delayed dispatch may be slow, never wrong.
+rm -f /tmp/_chaos2.log
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    GYM_TPU_FAULTS="serve.decode:delay=0.002,serve.prefill:delay=0.002" \
+    python -m pytest tests/test_serve.py -q -m 'not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_chaos2.log
+rc=${PIPESTATUS[0]}
+echo CHAOS_PARITY_DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' \
+    /tmp/_chaos2.log | tr -cd . | wc -c)
+[ "$rc" -ne 0 ] && exit "$rc"
+
+# Layer 3: HTTP chaos smoke. Fresh tiny checkpoint, then the real server
+# under an injected decode hang.
+OUT=${GYM_TPU_CI_CHAOS_OUT:-/tmp/gym_tpu_ci_chaos}
+PORT=${GYM_TPU_CI_CHAOS_PORT:-8742}
+rm -rf "$OUT"; mkdir -p "$OUT"
+
+timeout -k 10 300 env JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python - "$OUT" <<'EOF'
+import sys, numpy as np
+from gym_tpu import Trainer
+from gym_tpu.data import ArrayDataset
+from gym_tpu.models.nanogpt import GPT, GPTConfig
+from gym_tpu.strategy.optim import OptimSpec
+from gym_tpu.strategy.simple_reduce import SimpleReduceStrategy
+
+out = sys.argv[1]
+cfg = GPTConfig(block_size=32, vocab_size=48, n_layer=2, n_head=2,
+                n_embd=32, dropout=0.0)
+rng = np.random.default_rng(0)
+toks = rng.integers(0, 48, (64, 33))
+ds = ArrayDataset(toks[:, :-1].astype(np.int64),
+                  toks[:, 1:].astype(np.int64))
+Trainer(GPT(cfg), ds).fit(
+    strategy=SimpleReduceStrategy(optim_spec=OptimSpec("adamw", lr=1e-3)),
+    num_nodes=1, max_steps=4, batch_size=4, val_size=0, val_interval=0,
+    show_progress=False, seed=1, checkpoint_interval=4,
+    save_dir=out + "/ckpts", run_name="ci", log_dir=out + "/logs")
+print("ci_chaos: checkpoint trained")
+EOF
+rc=$?
+[ "$rc" -ne 0 ] && { echo "ci_chaos: training the smoke ckpt failed"; exit "$rc"; }
+
+# Injected hang at decode dispatch 4 (request A consumes dispatches 1-3,
+# so the hang lands in request B); the 15 s watchdog reaps it. Bare
+# `python ... &` so $! is the server pid, not a subshell's.
+env JAX_PLATFORMS=cpu PYTHONPATH="$REPO" \
+    GYM_TPU_FAULTS="serve.decode:hang=600@4" \
+    python -m gym_tpu.serve \
+    --ckpt "$OUT/ckpts/ci" --port "$PORT" --num_slots 2 --device cpu \
+    --dispatch-timeout 15 \
+    > "$OUT/server.log" 2>&1 &
+SRV=$!
+for _ in $(seq 1 90); do
+    grep -q "listening" "$OUT/server.log" && break
+    kill -0 "$SRV" 2>/dev/null || { echo "ci_chaos: server died at startup";
+        cat "$OUT/server.log"; exit 1; }
+    sleep 1
+done
+grep -q "listening" "$OUT/server.log" || {
+    echo "ci_chaos: server never started"; kill -9 "$SRV"; exit 1; }
+
+timeout -k 10 240 env GYM_TPU_CI_CHAOS_PORT="$PORT" python - <<'EOF'
+import json, os, time, urllib.error, urllib.request
+
+port = os.environ["GYM_TPU_CI_CHAOS_PORT"]
+
+def post(payload, timeout=120):
+    body = json.dumps(payload).encode()
+    t0 = time.perf_counter()
+    try:
+        r = urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate", body,
+            {"Content-Type": "application/json"}), timeout=timeout)
+        return r.status, json.loads(r.read()), r.headers, \
+            time.perf_counter() - t0
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), e.headers, \
+            time.perf_counter() - t0
+
+# A: dispatches 1-3 — completes, primes compiles + the tokens/s EWMA
+code, body, _, dt = post({"prompt": [1, 2, 3], "max_new_tokens": 4,
+                          "top_k": 4, "seed": 0, "deadline_s": 90})
+assert code == 200 and len(body["tokens"]) == 4, (code, body)
+print("ci_chaos: pre-chaos request ok", body["tokens"])
+
+# B: hits the hung dispatch 4 — must fail TYPED (503, not 500, not a
+# connection drop) INSIDE its deadline, via supervisor failover
+code, body, _, dt = post({"prompt": [1, 2, 3], "max_new_tokens": 8,
+                          "top_k": 4, "seed": 1, "deadline_s": 60})
+assert code == 503, (code, body)
+assert "EngineFailedError" in body["error"], body
+assert dt < 60, f"typed failure took {dt:.1f}s — past its deadline"
+print(f"ci_chaos: wedged request failed typed in {dt:.1f}s (503)")
+
+# C: post-chaos — the rebuilt engine serves cleanly
+code, body, _, dt = post({"prompt": [1, 2, 3], "max_new_tokens": 6,
+                          "top_k": 4, "seed": 2, "deadline_s": 90})
+assert code == 200 and len(body["tokens"]) == 6, (code, body)
+assert dt < 90, f"post-chaos request took {dt:.1f}s"
+print("ci_chaos: post-chaos request ok", body["tokens"])
+
+# D: infeasible deadline — shed at admission: 429 + Retry-After, never
+# enqueued
+code, body, headers, _ = post({"prompt": [1, 2, 3],
+                               "max_new_tokens": 28,
+                               "deadline_s": 1e-4})
+assert code == 429, (code, body)
+assert headers.get("Retry-After") is not None, dict(headers)
+print("ci_chaos: infeasible deadline shed at admission "
+      f"(429, Retry-After={headers['Retry-After']})")
+
+stats = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/stats", timeout=30).read())
+assert stats["engine_restarts"] == 1, stats
+assert stats["requests_rejected"] == 1, stats
+assert stats["status"] == "ok", stats
+print("ci_chaos: stats ok —",
+      json.dumps({k: stats[k] for k in
+                  ("engine_restarts", "requests_done", "requests_failed",
+                   "requests_rejected")}))
+EOF
+rc=$?
+[ "$rc" -ne 0 ] && { echo "ci_chaos: HTTP chaos drill failed";
+    cat "$OUT/server.log"; kill -9 "$SRV"; exit "$rc"; }
+
+grep -q "supervisor — engine rebuilt" "$OUT/server.log" || {
+    echo "ci_chaos: no supervisor-rebuild line in server log";
+    cat "$OUT/server.log"; exit 1; }
+
+# SIGTERM drill: the server must still exit 0 cleanly AFTER an engine
+# failover (the abandoned wedged thread is a daemon, still asleep)
+kill -TERM "$SRV"
+wait "$SRV"; rc=$?
+[ "$rc" -ne 0 ] && { echo "ci_chaos: server exit rc=$rc after SIGTERM";
+    cat "$OUT/server.log"; exit 1; }
+grep -q "shut down cleanly" "$OUT/server.log" || {
+    echo "ci_chaos: no clean-shutdown line"; cat "$OUT/server.log"; exit 1; }
+grep -q "engine restart" "$OUT/server.log" || {
+    echo "ci_chaos: no restart count in shutdown line";
+    cat "$OUT/server.log"; exit 1; }
+
+# bench rider: one-line shed/recovered/percentile headline
+timeout -k 10 600 python "$REPO/bench.py" --chaos-only \
+    > "$OUT/chaos_bench.json" 2> "$OUT/chaos_bench.err" || {
+    echo "ci_chaos: bench.py --chaos-only failed";
+    cat "$OUT/chaos_bench.err"; exit 1; }
+python - "$OUT/chaos_bench.json" <<'EOF'
+import json, sys
+with open(sys.argv[1]) as f:
+    head = json.loads(f.read().strip().splitlines()[-1])["chaos"]
+assert head["recovered"] is True, head
+assert head["faulted"]["engine_restarts"] >= 1, head
+assert head["faulted"]["post_chaos_request_ok"] is True, head
+assert head["clean"]["ttft_p99_s"] is not None, head
+print("ci_chaos: bench headline ok —", json.dumps({
+    "clean_p99_ttft_s": head["clean"]["ttft_p99_s"],
+    "faulted_p99_ttft_s": head["faulted"]["ttft_p99_s"],
+    "shed_at_admission": head["faulted"]["shed_at_admission"],
+    "engine_restarts": head["faulted"]["engine_restarts"]}))
+EOF
+rc=$?
+[ "$rc" -ne 0 ] && exit "$rc"
+echo "ci_chaos: OK (log at $OUT/server.log)"
+exit 0
