@@ -49,8 +49,14 @@ _UNAVAILABLE_MARKERS = (
 
 
 def _marker(error: str, detail: str, tail: str = "") -> dict:
+    # "status"/"measured" make not-a-measurement EXPLICIT in the emitted
+    # JSON: r04/r05 recorded backend-down runs that downstream tooling
+    # could mistake for perf data — the trajectory must distinguish
+    # "regressed" from "not measured" without parsing error strings.
     return {
         "error": error,
+        "status": "not_measured",
+        "measured": False,
         "metric": "nanogpt_diloco_64node_iterations_per_sec",
         "detail": detail,
         "tail": tail[-1500:],
@@ -77,10 +83,12 @@ def _classify_and_report(blob: str, detail: str) -> int:
 
 def _supervise() -> int:
     """Probe the accelerator, then run the measurement under a watchdog."""
-    # --sim-only / --chaos-only are host-side by construction (modeled
-    # network; injected host faults) — never touch the accelerator
+    # --sim-only / --chaos-only / --analyze-only are host-side by
+    # construction (modeled network; injected host faults; abstract
+    # tracing) — never touch the accelerator
     force_cpu = ("--cpu" in sys.argv or "--sim-only" in sys.argv
-                 or "--chaos-only" in sys.argv)
+                 or "--chaos-only" in sys.argv
+                 or "--analyze-only" in sys.argv)
     if not force_cpu:
         probe_cmd = [sys.executable, "-c",
                      "import jax; print('PLATFORM=' + jax.devices()[0].platform)"]
@@ -684,9 +692,33 @@ def measure_chaos() -> dict:
     }
 
 
+def measure_analysis() -> dict:
+    """Static-analysis summary (ISSUE 6): the full suite — lint, static
+    trace reconciliation, jaxpr audit — as one JSON line, the
+    machine-readable twin of `python -m gym_tpu.analysis`. Pure host
+    tracing; 'violations' == 0 is the shipped-tree invariant."""
+    from gym_tpu.analysis.__main__ import run_all
+
+    report = run_all()
+    sections = report["sections"]
+    trace = sections["trace"]["strategies"]
+    return {
+        "violations": report["violations"],
+        "lint_total": sections["lint"]["total"],
+        "lint_suppressed": sections["lint"]["suppressed"],
+        "strategies_reconciled": sum(1 for s in trace.values() if s["ok"]),
+        "strategies_checked": len(trace),
+        "programs_audited": len(sections["audit"]["programs"]),
+        "program_keys": sections["audit"]["recompile_guard"]["n_keys"],
+        "seconds": round(sum(s.get("seconds", 0)
+                             for s in sections.values()), 2),
+    }
+
+
 def main() -> None:
     force_cpu = ("--cpu" in sys.argv or "--sim-only" in sys.argv
-                 or "--chaos-only" in sys.argv)
+                 or "--chaos-only" in sys.argv
+                 or "--analyze-only" in sys.argv)
     if force_cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
 
@@ -725,6 +757,10 @@ def main() -> None:
 
     if "--chaos-only" in sys.argv:
         print(json.dumps({"chaos": measure_chaos()}))
+        return
+
+    if "--analyze-only" in sys.argv:
+        print(json.dumps({"analysis": measure_analysis()}))
         return
 
     import numpy as np
@@ -814,6 +850,8 @@ def main() -> None:
     mfu = node_mfu(cfg, state.params, NUM_NODES * BATCH_PER_NODE, 1.0 / it_s)
     result = {
         "metric": "nanogpt_diloco_64node_iterations_per_sec",
+        "status": "measured",
+        "measured": True,
         "value": round(it_s, 3),
         "unit": "it/s",
         "vs_baseline": round(it_s / baseline, 2),

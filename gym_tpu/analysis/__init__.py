@@ -1,0 +1,48 @@
+"""Static-analysis subsystem (ISSUE 6): jaxpr program auditing, static
+comm-trace verification, and a host-concurrency lint.
+
+Three independent checkers share one CLI (``python -m gym_tpu.analysis``)
+and one CI gate (``scripts/ci_analyze.sh``):
+
+- ``jaxpr_audit``  — abstractly traces every compiled program the repo
+  ships (the trainer step per strategy, the serving engine's bucketed
+  prefill and fused decode) and statically checks donation aliasing,
+  host-callback freedom and f64 upcasts; emits a canonical *program key*
+  per program — the future device-program-registry key (ROADMAP item 5)
+  — plus a recompile-guard report over the key set.
+- ``trace_check``  — the static twin of the PR-3 runtime reconciliation:
+  extracts the collective inventory (op, payload bytes, group) from each
+  strategy's jaxpr and reconciles it, step by step over a full comm
+  cycle, against the host-declared ``Strategy.comm_events`` trace. Runs
+  in milliseconds with no fit, so every new strategy must pass it to
+  land.
+- ``lint``         — an AST linter enforcing the host-side conventions
+  the resilience/serving PRs established (typed exceptions, no lock held
+  across a blocking call, consistent lock order, ``perf_counter`` for
+  durations), with a checked-in ratcheting suppression file.
+
+Everything here TRACES — nothing is compiled or executed on a device, so
+the whole suite is safe to run on a loaded CI host.
+"""
+
+from .jaxpr_tools import (CollectiveSite, WalkReport, abstract_node_ctx,
+                          trace_with_axis_env, walk_jaxpr)
+from .jaxpr_audit import (ProgramAudit, ProgramSpec, audit_program,
+                          audit_shipped_programs, program_key,
+                          recompile_guard, shipped_programs)
+from .trace_check import (ReconcileResult, StepReconcile, check_strategy,
+                          check_all_strategies, default_strategy_suite,
+                          extract_step_inventory)
+from .lint import LintViolation, load_suppressions, run_lint
+
+__all__ = [
+    "CollectiveSite", "WalkReport", "abstract_node_ctx",
+    "trace_with_axis_env", "walk_jaxpr",
+    "ProgramAudit", "ProgramSpec", "audit_program",
+    "audit_shipped_programs", "program_key", "recompile_guard",
+    "shipped_programs",
+    "ReconcileResult", "StepReconcile", "check_strategy",
+    "check_all_strategies", "default_strategy_suite",
+    "extract_step_inventory",
+    "LintViolation", "load_suppressions", "run_lint",
+]

@@ -1,0 +1,421 @@
+"""Jaxpr program auditor: donation, host callbacks, f64, program keys.
+
+Every compiled program the repo ships — the trainer step for each
+strategy (the function ``NodeRuntime.compile`` jits under ``shard_map``)
+and the serving engine's bucketed prefill / admit / fused
+``decode_chunk`` programs — is abstractly traced (never compiled or
+executed) and checked:
+
+- **Donation** — an argument donated via ``donate_argnums`` whose buffer
+  XLA cannot alias to an output (no output with the same shape/dtype
+  remains unmatched) is a *silently-unaliased donation*: the caller gave
+  the buffer up, XLA copied anyway, and peak memory is what donation was
+  supposed to save. Unused donated inputs are flagged too.
+- **Host callbacks** — ``pure_callback`` / ``io_callback`` /
+  ``debug_callback`` in a hot-path program force a device→host round
+  trip per dispatch and break async dispatch; the audit requires zero.
+- **f64 upcasts** — any equation producing float64/complex128 outside an
+  allowlist (a stray Python float in a jnp op under ``jax_enable_x64``
+  doubles the payload of everything downstream).
+
+Each program also gets a canonical **program key** =
+``(name × static config × input shapes/dtypes × donation mask)`` whose
+hash is the planned registry key for ROADMAP item 5 (the unified
+device-program registry shared by trainer dispatch, the engine LRUs and
+the persistent compile cache). ``recompile_guard`` reports key
+collisions and *near misses* — two keys identical except for the
+donation mask or a single dtype, the classic signature of an accidental
+recompile (same logical program, different jit options).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from .jaxpr_tools import trace_with_axis_env, walk_jaxpr
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class Finding:
+    """One audit violation."""
+
+    program: str
+    kind: str        # donation-unaliased | donation-unused | host-callback
+    #                | f64-upcast
+    detail: str
+
+    def as_dict(self) -> Dict[str, str]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class ProgramSpec:
+    """A shipped program, described for the auditor: the traceable
+    function, its example argument templates (``ShapeDtypeStruct``
+    pytrees), which positional args are donated (mirroring the real
+    ``jax.jit``/``NodeRuntime.compile`` donation convention), and the
+    static config that goes into the program key."""
+
+    name: str
+    fn: Callable
+    args: Tuple[Any, ...]
+    donate_args: Tuple[int, ...] = ()
+    hot_path: bool = True
+    axis_sizes: Optional[Dict[str, int]] = None
+    config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    family: str = ""
+
+
+@dataclasses.dataclass
+class ProgramAudit:
+    name: str
+    key: str                 # canonical descriptor (json)
+    key_hash: str            # sha256[:16] — the registry key
+    findings: List[Finding]
+    n_eqns: int
+    n_collectives: int
+    family: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name, "family": self.family,
+            "key_hash": self.key_hash, "ok": self.ok,
+            "n_eqns": self.n_eqns, "n_collectives": self.n_collectives,
+            "findings": [f.as_dict() for f in self.findings],
+        }
+
+
+def _leaf_avals(tree: PyTree) -> List[Tuple[Tuple[int, ...], str]]:
+    out = []
+    for leaf in jax.tree.leaves(tree):
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = str(np.dtype(getattr(leaf, "dtype", np.float32)))
+        out.append((shape, dtype))
+    return out
+
+
+def _jsonable_config(config: Dict[str, Any]) -> Dict[str, str]:
+    return {str(k): repr(v) for k, v in sorted(config.items())}
+
+
+def program_key(name: str, config: Dict[str, Any], args: Sequence[Any],
+                donate_args: Sequence[int],
+                out_avals: Optional[Sequence[Tuple]] = None
+                ) -> Tuple[str, str]:
+    """Canonical program key: ``(name × config × input shapes/dtypes ×
+    donation mask)`` as a deterministic JSON string plus its sha256[:16]
+    hash — the future device-program-registry key (ROADMAP item 5). Two
+    dispatches whose keys hash equal may share a compiled executable;
+    two programs with the same ``name``/``config`` but different keys
+    are a recompile."""
+    desc = {
+        "name": name,
+        "config": _jsonable_config(config),
+        "in_avals": [_leaf_avals(a) for a in args],
+        "donated": sorted(int(i) for i in donate_args),
+    }
+    if out_avals is not None:
+        desc["out_avals"] = list(out_avals)
+    canon = json.dumps(desc, sort_keys=True, separators=(",", ":"))
+    return canon, hashlib.sha256(canon.encode()).hexdigest()[:16]
+
+
+def _count_eqns(jaxpr) -> int:
+    from .jaxpr_tools import _sub_jaxprs
+
+    n = len(jaxpr.eqns)
+    for eqn in jaxpr.eqns:
+        n += sum(_count_eqns(s.jaxpr) for s in _sub_jaxprs(eqn.params))
+    return n
+
+
+def audit_program(spec: ProgramSpec,
+                  f64_allow: Sequence[str] = ()) -> ProgramAudit:
+    """Trace ``spec.fn`` abstractly and run every static check."""
+    closed = trace_with_axis_env(spec.fn, spec.args, spec.axis_sizes)
+    node_axes = tuple((spec.axis_sizes or {}).keys())
+    report = walk_jaxpr(closed, node_axes=node_axes,
+                        axis_sizes=spec.axis_sizes or {}, fold=False)
+    findings: List[Finding] = []
+
+    if spec.hot_path:
+        for cb in report.callbacks:
+            findings.append(Finding(
+                spec.name, "host-callback",
+                f"host callback staged in a hot-path program at {cb} — "
+                f"each dispatch pays a device→host round trip"))
+
+    allow = tuple(f64_allow)
+    for site in report.f64_eqns:
+        if any(a in site for a in allow):
+            continue
+        findings.append(Finding(
+            spec.name, "f64-upcast",
+            f"float64/complex128 produced at {site} (not in allowlist) — "
+            f"silent 2× payload on everything downstream"))
+
+    findings.extend(_audit_donation(spec, closed))
+
+    key, key_hash = program_key(spec.name, spec.config, spec.args,
+                                spec.donate_args)
+    return ProgramAudit(
+        name=spec.name, key=key, key_hash=key_hash, findings=findings,
+        n_eqns=_count_eqns(closed.jaxpr),
+        n_collectives=len(report.data_collectives()),
+        family=spec.family or spec.name.split("[")[0])
+
+
+def _audit_donation(spec: ProgramSpec, closed) -> List[Finding]:
+    """Shape/dtype multiset matching between donated inputs and outputs
+    (XLA's aliasing criterion), plus a consumed check on the flattened
+    invars. The jaxpr invars are the flattened leaves of all positional
+    args in order, which is how ``jax.jit`` resolves ``donate_argnums``
+    to buffers."""
+    findings: List[Finding] = []
+    # flattened leaf spans per positional arg
+    spans: List[Tuple[int, int]] = []
+    off = 0
+    for a in spec.args:
+        n = len(jax.tree.leaves(a))
+        spans.append((off, off + n))
+        off += n
+    invars = closed.jaxpr.invars
+    if off != len(invars):
+        # tokens/effects can extend invars; donation audit stays valid
+        # for the leading arg leaves
+        invars = invars[:off]
+
+    used = set()
+    for eqn in closed.jaxpr.eqns:
+        for a in eqn.invars:
+            used.add(id(a))
+    outset = {id(v) for v in closed.jaxpr.outvars}
+
+    out_pool: Dict[Tuple, int] = {}
+    for ov in closed.jaxpr.outvars:
+        aval = getattr(ov, "aval", None)
+        if aval is None:
+            continue
+        k = (tuple(aval.shape), str(np.dtype(aval.dtype)))
+        out_pool[k] = out_pool.get(k, 0) + 1
+
+    for ai in spec.donate_args:
+        lo, hi = spans[ai]
+        for j, v in enumerate(invars[lo:hi]):
+            aval = v.aval
+            k = (tuple(aval.shape), str(np.dtype(aval.dtype)))
+            if id(v) not in used and id(v) not in outset:
+                findings.append(Finding(
+                    spec.name, "donation-unused",
+                    f"donated arg {ai} leaf {j} {k} is never consumed — "
+                    f"the donation frees nothing and hides a dead input"))
+                continue
+            if out_pool.get(k, 0) > 0:
+                out_pool[k] -= 1
+            else:
+                findings.append(Finding(
+                    spec.name, "donation-unaliased",
+                    f"donated arg {ai} leaf {j} {k} has no remaining "
+                    f"output of the same shape/dtype — XLA cannot alias "
+                    f"it and will silently copy (donation wasted)"))
+    return findings
+
+
+# -- the shipped-program registry -----------------------------------------
+
+
+def _tiny_gpt_config():
+    from ..models.nanogpt import GPTConfig
+
+    return GPTConfig(block_size=32, vocab_size=64, n_layer=1, n_head=2,
+                     n_embd=32, dropout=0.0, bias=True)
+
+
+def trainer_step_specs(num_nodes: int = 4, n_micro: int = 1,
+                       micro_bs: int = 2, seq_len: int = 16
+                       ) -> List[ProgramSpec]:
+    """One ProgramSpec per shipped strategy: the exact per-node function
+    ``Trainer.fit`` hands to ``NodeRuntime.compile`` (``make_train_step``
+    over the real GPT loss model), with the runtime's donation
+    convention (``donate_state=True`` → arg 0, the TrainState)."""
+    import jax.numpy as jnp
+    from jax import core
+
+    from ..models.base import LossModel
+    from ..models.nanogpt import GPT
+    from ..train_node import make_init_fn, make_train_step
+    from .jaxpr_tools import abstract_node_ctx
+    from .trace_check import default_strategy_suite
+
+    cfg = _tiny_gpt_config()
+    loss_model = LossModel(GPT(cfg))
+    x = jax.ShapeDtypeStruct((n_micro, micro_bs, seq_len), np.int32)
+    batch_tpl = (x, x)
+    # closed over by init_fn (not a traced argument), so it must be a
+    # concrete array — a few hundred bytes of zeros
+    ex = np.zeros((micro_bs, seq_len), np.int32)
+    example_micro = (ex, ex)
+    specs = []
+    for name, strategy in default_strategy_suite().items():
+        n_virt = 2 if name.endswith("_vnode") else 1
+        ctx = abstract_node_ctx(num_nodes, n_virt=n_virt)
+        strategy.finalize(64)
+        strategy.bind_ctx(ctx)
+        axis_sizes = dict(zip(ctx.axes, ctx.sizes))
+        init_fn = make_init_fn(loss_model, strategy, example_micro,
+                               seed=0, ctx=ctx)
+        with core.extend_axis_env_nd(list(axis_sizes.items())):
+            state_tpl = jax.eval_shape(
+                init_fn, jax.ShapeDtypeStruct((), np.int32))
+        node_step = make_train_step(loss_model, strategy, ctx)
+        specs.append(ProgramSpec(
+            name=f"trainer.step[{name}]", fn=node_step,
+            args=(state_tpl, batch_tpl), donate_args=(0,),
+            axis_sizes=axis_sizes,
+            config={"model": "gpt-tiny", "num_nodes": num_nodes,
+                    **strategy.config()},
+            family="trainer.step"))
+    return specs
+
+
+def engine_program_specs(num_slots: int = 2, decode_chunk: int = 4,
+                         buckets: Sequence[int] = (8, 32)
+                         ) -> List[ProgramSpec]:
+    """The serving engine's three program families, traced exactly as
+    ``serve/engine.py`` jits them (global LRU builders), with their real
+    donation masks: prefill (none), admit (cache, arg 0), decode (cache,
+    arg 1)."""
+    import dataclasses as _dc
+
+    from ..models.nanogpt import GPT, decode_config
+    from ..serve.engine import _prefill_program, _slot_programs
+
+    cfg = decode_config(_tiny_gpt_config())
+    cfg_tuple = _dc.astuple(cfg)
+    model = GPT(cfg)
+
+    params_tpl = jax.eval_shape(
+        lambda: model.init({"params": jax.random.PRNGKey(0)},
+                           jax.numpy.zeros((1, 1), np.int32),
+                           train=False))["params"]
+    row_cache_tpl = jax.eval_shape(
+        lambda: model.init({"params": jax.random.PRNGKey(0)},
+                           jax.numpy.zeros((1, 1), np.int32),
+                           train=False))["cache"]
+    slot_cache_tpl = jax.eval_shape(
+        lambda: model.init({"params": jax.random.PRNGKey(0)},
+                           jax.numpy.zeros((num_slots, 1), np.int32),
+                           train=False))["cache"]
+
+    scalar = lambda dt: jax.ShapeDtypeStruct((), dt)  # noqa: E731
+    vec = lambda dt: jax.ShapeDtypeStruct((num_slots,), dt)  # noqa: E731
+    key_t = jax.ShapeDtypeStruct((2,), np.uint32)
+
+    specs: List[ProgramSpec] = []
+    for bucket in buckets:
+        prefill = _prefill_program(cfg_tuple, int(bucket))
+        specs.append(ProgramSpec(
+            name=f"serve.prefill[bucket={bucket}]", fn=prefill,
+            args=(params_tpl,
+                  jax.ShapeDtypeStruct((1, int(bucket)), np.int32),
+                  scalar(np.int32), key_t, scalar(np.float32),
+                  scalar(np.int32), scalar(np.float32)),
+            donate_args=(), config={"config": cfg_tuple, "bucket": bucket},
+            family="serve.prefill"))
+
+    admit, decode = _slot_programs(cfg_tuple, num_slots, decode_chunk)
+    specs.append(ProgramSpec(
+        name=f"serve.admit[slots={num_slots}]", fn=admit,
+        args=(slot_cache_tpl, row_cache_tpl, scalar(np.int32),
+              scalar(np.int32)),
+        donate_args=(0,),
+        config={"config": cfg_tuple, "num_slots": num_slots},
+        family="serve.admit"))
+    specs.append(ProgramSpec(
+        name=f"serve.decode[slots={num_slots},chunk={decode_chunk}]",
+        fn=decode,
+        args=(params_tpl, slot_cache_tpl, vec(np.int32), vec(np.bool_),
+              jax.ShapeDtypeStruct((num_slots, 2), np.uint32),
+              vec(np.int32), vec(np.int32), vec(np.int32),
+              vec(np.float32), vec(np.int32), vec(np.float32)),
+        donate_args=(1,),
+        config={"config": cfg_tuple, "num_slots": num_slots,
+                "decode_chunk": decode_chunk},
+        family="serve.decode"))
+    return specs
+
+
+def shipped_programs(num_nodes: int = 4) -> List[ProgramSpec]:
+    """Every compiled program the repo ships, audit-sized (tiny model:
+    the checks are structural — donation masks, callback freedom, dtype
+    discipline — and shape-independent)."""
+    return trainer_step_specs(num_nodes) + engine_program_specs()
+
+
+def recompile_guard(audits: Sequence[ProgramAudit]) -> Dict[str, Any]:
+    """Key-collision / near-miss report over a set of program audits.
+
+    - ``collisions``: two DIFFERENT canonical descriptors hashing equal
+      (must never happen), or the same program name audited twice with
+      different keys (a recompile of the "same" program).
+    - ``near_misses``: key pairs within one family identical except for
+      the donation mask — the classic accidental-recompile cause (same
+      logical program, different jit options ⇒ two executables)."""
+    by_hash: Dict[str, str] = {}
+    by_name: Dict[str, set] = {}
+    collisions: List[str] = []
+    for a in audits:
+        prev = by_hash.get(a.key_hash)
+        if prev is not None and prev != a.key:
+            collisions.append(
+                f"hash collision: {a.key_hash} maps to two descriptors")
+        by_hash[a.key_hash] = a.key
+        by_name.setdefault(a.name, set()).add(a.key_hash)
+    for name, hashes in by_name.items():
+        if len(hashes) > 1:
+            collisions.append(
+                f"program {name!r} produced {len(hashes)} distinct keys "
+                f"— every re-audit should be key-stable")
+
+    near: List[str] = []
+    descs = [(a, json.loads(a.key)) for a in audits]
+    for i in range(len(descs)):
+        for j in range(i + 1, len(descs)):
+            a, da = descs[i]
+            b, db = descs[j]
+            if a.family != b.family or a.key_hash == b.key_hash:
+                continue
+            same_but_donation = (
+                da["in_avals"] == db["in_avals"]
+                and da["config"] == db["config"]
+                and da["donated"] != db["donated"])
+            if same_but_donation:
+                near.append(
+                    f"{a.name} vs {b.name}: identical program, different "
+                    f"donation mask — two executables for one program")
+    return {"collisions": collisions, "near_misses": near,
+            "n_keys": len(by_hash)}
+
+
+def audit_shipped_programs(num_nodes: int = 4) -> Dict[str, Any]:
+    """Audit every shipped program; the CLI/CI entry point."""
+    audits = [audit_program(s) for s in shipped_programs(num_nodes)]
+    guard = recompile_guard(audits)
+    n_findings = sum(len(a.findings) for a in audits)
+    return {
+        "programs": [a.as_dict() for a in audits],
+        "recompile_guard": guard,
+        "violations": n_findings + len(guard["collisions"]),
+    }

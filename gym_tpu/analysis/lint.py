@@ -1,0 +1,414 @@
+"""Host-concurrency + typed-error lint over ``gym_tpu/``.
+
+The last three PRs established host-side conventions by review memory
+alone; this AST linter makes them machine-checked:
+
+- **GT101 bare-assert** — no ``assert`` in library code: asserts vanish
+  under ``python -O`` and raise an untyped ``AssertionError`` callers
+  can't branch on. Raise a typed exception with a message instead.
+- **GT102 lock-across-blocking-call** — no ``threading.Lock`` /
+  ``Condition`` held across a blocking call (``queue.get/put``,
+  ``Future.result``, ``Thread.join``, ``time.sleep``, ``Event.wait``,
+  subprocess, Orbax manager IO, ``jax.device_get``): a stalled callee
+  wedges every thread contending for the lock — exactly the failure
+  mode the serving watchdog exists to catch. ``Condition.wait`` on the
+  condition *being held* is exempt (it releases the lock).
+- **GT103 lock-order** — the lock-acquisition graph (edges = "B
+  acquired while holding A") must be acyclic, and a lock must never be
+  nested inside itself through a ``Condition`` alias
+  (``Condition(self._lock)`` is the SAME underlying lock; nesting them
+  self-deadlocks a non-reentrant lock).
+- **GT104 untyped-raise** — no ``raise RuntimeError(...)`` /
+  ``raise Exception(...)`` where the module vocabulary has typed error
+  classes; callers branch on class, not on message strings.
+- **GT105 wallclock-timing** — ``time.time()`` measures the wall clock
+  (NTP steps move it); durations and throughput use
+  ``time.perf_counter()``. Timestamp uses (run names, log epochs) go in
+  the suppression file with a reason.
+
+Detection is deliberately *assignment-grounded*: a ``with self._x:``
+block counts as a lock region only when the same module assigns
+``self._x = threading.Lock()/RLock()/Condition(...)`` — no name
+guessing. ``.join``/``.get``/``.put`` receivers use documented name
+heuristics (threads/queues) to stay quiet on ``str.join``/``dict.get``.
+
+Suppressions ratchet: ``suppressions.txt`` holds
+``path:RULE = count  # reason`` budgets. Violations beyond the budget
+fail the gate; counts below it are reported so the budget can be
+lowered. The gate starts green and only tightens.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+_DEFAULT_SUPPRESSIONS = os.path.join(os.path.dirname(__file__),
+                                     "suppressions.txt")
+
+_THREADY = re.compile(r"thread|proc|worker|writer|driver|pool|child",
+                      re.IGNORECASE)
+_QUEUEY = re.compile(r"(^|_)q(ueue)?$|queue", re.IGNORECASE)
+
+
+@dataclasses.dataclass(frozen=True)
+class LintViolation:
+    file: str
+    line: int
+    rule: str
+    msg: str
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: {self.rule} {self.msg}"
+
+
+def _attr_chain(node) -> str:
+    """Dotted name of a Name/Attribute chain ('self._lock', 'time')."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _last_name(node) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+class _LockInventory(ast.NodeVisitor):
+    """Pass 1: which attributes/names in this module ARE locks, which
+    are conditions (and over which lock), which are events."""
+
+    def __init__(self):
+        self.locks: Set[str] = set()          # 'self._lock', module names
+        self.conditions: Dict[str, Optional[str]] = {}  # cond -> lock alias
+        self.events: Set[str] = set()
+
+    def visit_Assign(self, node):
+        if isinstance(node.value, ast.Call):
+            callee = _attr_chain(node.value.func)
+            kind = callee.rsplit(".", 1)[-1]
+            for tgt in node.targets:
+                name = _attr_chain(tgt)
+                if not name:
+                    continue
+                if kind in ("Lock", "RLock"):
+                    self.locks.add(name)
+                elif kind == "Condition":
+                    alias = None
+                    if node.value.args:
+                        alias = _attr_chain(node.value.args[0]) or None
+                    self.conditions[name] = alias
+                elif kind == "Event":
+                    self.events.add(name)
+        self.generic_visit(node)
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, tree: ast.Module, source: str):
+        self.path = path
+        self.tree = tree
+        self.violations: List[LintViolation] = []
+        inv = _LockInventory()
+        inv.visit(tree)
+        self.inv = inv
+        # every name that acquires the underlying-lock when used in
+        # `with`: locks + conditions (a Condition's __enter__ acquires
+        # its lock)
+        self.lockish: Set[str] = set(inv.locks) | set(inv.conditions)
+        self.class_stack: List[str] = []
+        self.held: List[str] = []             # lock names currently held
+        self.edges: Set[Tuple[str, str]] = set()
+        self.edge_lines: Dict[Tuple[str, str], int] = {}
+
+    # -- helpers ----------------------------------------------------------
+
+    def _emit(self, node, rule: str, msg: str):
+        self.violations.append(
+            LintViolation(self.path, getattr(node, "lineno", 0), rule, msg))
+
+    def _underlying(self, name: str) -> str:
+        """Resolve a Condition to the lock it wraps (or itself)."""
+        alias = self.inv.conditions.get(name)
+        return alias or name
+
+    def _qual(self, name: str) -> str:
+        cls = self.class_stack[-1] if self.class_stack else "<module>"
+        return f"{cls}.{name}"
+
+    # -- structure --------------------------------------------------------
+
+    def visit_ClassDef(self, node):
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def visit_Assert(self, node):
+        self._emit(node, "GT101",
+                   "bare assert in library code — raise a typed "
+                   "exception (survives -O, callers can branch on class)")
+        self.generic_visit(node)
+
+    def visit_Raise(self, node):
+        exc = node.exc
+        if isinstance(exc, ast.Call):
+            name = _last_name(exc.func)
+            if name in ("RuntimeError", "Exception", "AssertionError"):
+                self._emit(node, "GT104",
+                           f"raise {name}(...) — use a typed error class "
+                           f"(callers branch on class, not message)")
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        chain = _attr_chain(node.func)
+        if chain == "time.time":
+            self._emit(node, "GT105",
+                       "time.time() — use time.perf_counter() for "
+                       "durations/throughput (wall clock steps under NTP)")
+        if self.held:
+            self._check_blocking(node)
+        self.generic_visit(node)
+
+    def visit_With(self, node):
+        self._handle_with(node)
+
+    def visit_AsyncWith(self, node):
+        self._handle_with(node)
+
+    # don't carry `held` into nested function bodies: they run later,
+    # on some other call stack
+    def visit_FunctionDef(self, node):
+        saved, self.held = self.held, []
+        self.generic_visit(node)
+        self.held = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        saved, self.held = self.held, []
+        self.generic_visit(node)
+        self.held = saved
+
+    # -- lock regions ------------------------------------------------------
+
+    def _handle_with(self, node):
+        acquired: List[str] = []
+        for item in node.items:
+            name = _attr_chain(item.context_expr)
+            if name in self.lockish:
+                under = self._underlying(name)
+                for h in self.held:
+                    if self._underlying(h) == under:
+                        self._emit(node, "GT103",
+                                   f"`with {name}` nested inside `with "
+                                   f"{h}` — same underlying lock "
+                                   f"(Condition alias): self-deadlock on "
+                                   f"a non-reentrant lock")
+                    else:
+                        edge = (self._qual(self._underlying(h)),
+                                self._qual(under))
+                        self.edges.add(edge)
+                        self.edge_lines.setdefault(edge, node.lineno)
+                acquired.append(name)
+        self.held.extend(acquired)
+        self.generic_visit(node)
+        for _ in acquired:
+            self.held.pop()
+
+    def _check_blocking(self, call: ast.Call):
+        func = call.func
+        chain = _attr_chain(func)
+        attr = _last_name(func)
+        recv = func.value if isinstance(func, ast.Attribute) else None
+        recv_chain = _attr_chain(recv) if recv is not None else ""
+        recv_name = _last_name(recv) if recv is not None else ""
+        held = ", ".join(self.held)
+
+        def emit(why: str):
+            self._emit(call, "GT102",
+                       f"{why} while holding `{held}` — a stalled callee "
+                       f"wedges every thread contending for the lock")
+
+        if chain == "time.sleep" or chain == "sleep":
+            emit("time.sleep()")
+        elif chain == "os.fsync" or attr == "fsync":
+            emit("os.fsync() (disk-durability barrier)")
+        elif attr == "result" and recv is not None:
+            emit(f"`{recv_chain}.result()` (Future wait)")
+        elif attr == "join" and recv is not None \
+                and not isinstance(recv, ast.Constant) \
+                and "path" not in recv_chain \
+                and (_THREADY.search(recv_chain) or recv_name == "t"):
+            emit(f"`{recv_chain}.join()` (thread join)")
+        elif attr in ("get", "put") and _QUEUEY.search(recv_chain):
+            emit(f"`{recv_chain}.{attr}()` (queue op)")
+        elif attr in ("wait", "wait_for"):
+            if recv_chain in self.inv.conditions:
+                under = self._underlying(recv_chain)
+                if not any(self._underlying(h) == under
+                           for h in self.held):
+                    emit(f"`{recv_chain}.wait()` on a condition whose "
+                         f"lock is NOT the one held")
+            elif recv_chain in self.inv.events \
+                    or _last_name(recv) in ("_stop", "stop"):
+                emit(f"`{recv_chain}.wait()` (event wait)")
+        elif recv_chain.startswith("subprocess") \
+                or chain.startswith("subprocess."):
+            emit(f"`{chain}()` (subprocess)")
+        elif attr in ("save", "restore") and "manager" in recv_chain:
+            emit(f"`{recv_chain}.{attr}()` (Orbax IO)")
+        elif attr in ("device_get", "block_until_ready"):
+            emit(f"`{chain}()` (device sync)")
+
+    # -- finish ------------------------------------------------------------
+
+    def finish(self) -> Tuple[Set[Tuple[str, str]],
+                              Dict[Tuple[str, str], int]]:
+        return self.edges, self.edge_lines
+
+
+def _check_lock_order(all_edges: Dict[Tuple[str, str], Tuple[str, int]]
+                      ) -> List[LintViolation]:
+    """Cycle detection over the cross-module acquisition graph."""
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in all_edges:
+        graph.setdefault(a, set()).add(b)
+
+    violations: List[LintViolation] = []
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color: Dict[str, int] = {}
+    stack: List[str] = []
+
+    def dfs(n: str):
+        color[n] = GRAY
+        stack.append(n)
+        for m in graph.get(n, ()):
+            if color.get(m, WHITE) == GRAY:
+                cyc = stack[stack.index(m):] + [m]
+                file, line = all_edges.get((n, m), ("<graph>", 0))
+                violations.append(LintViolation(
+                    file, line, "GT103",
+                    f"lock acquisition cycle: {' -> '.join(cyc)} — "
+                    f"two threads taking these in opposite order deadlock"))
+            elif color.get(m, WHITE) == WHITE:
+                dfs(m)
+        stack.pop()
+        color[n] = BLACK
+
+    for n in list(graph):
+        if color.get(n, WHITE) == WHITE:
+            dfs(n)
+    return violations
+
+
+def _iter_py_files(root: str) -> Iterable[str]:
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for f in sorted(filenames):
+            if f.endswith(".py"):
+                yield os.path.join(dirpath, f)
+
+
+def run_lint(root: str, rel_to: Optional[str] = None
+             ) -> List[LintViolation]:
+    """Lint every ``.py`` under ``root``; paths in the result are
+    relative to ``rel_to`` (default: ``root``'s parent, so files read
+    ``gym_tpu/...`` when linting the package dir)."""
+    rel_to = rel_to or os.path.dirname(os.path.abspath(root))
+    violations: List[LintViolation] = []
+    all_edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    for path in _iter_py_files(root):
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        rel = os.path.relpath(path, rel_to).replace(os.sep, "/")
+        try:
+            tree = ast.parse(source, filename=rel)
+        except SyntaxError as e:
+            violations.append(LintViolation(rel, e.lineno or 0, "GT000",
+                                            f"syntax error: {e.msg}"))
+            continue
+        linter = _Linter(rel, tree, source)
+        linter.visit(tree)
+        violations.extend(linter.violations)
+        edges, lines = linter.finish()
+        for e in edges:
+            all_edges.setdefault(e, (rel, lines.get(e, 0)))
+    violations.extend(_check_lock_order(all_edges))
+    return sorted(violations, key=lambda v: (v.file, v.line, v.rule))
+
+
+def lint_source(source: str, path: str = "<snippet>"
+                ) -> List[LintViolation]:
+    """Lint one source string — the unit-test surface for pinning each
+    rule on a minimal ``ast.parse``-able snippet."""
+    tree = ast.parse(source, filename=path)
+    linter = _Linter(path, tree, source)
+    linter.visit(tree)
+    out = list(linter.violations)
+    edges, lines = linter.finish()
+    out.extend(_check_lock_order(
+        {e: (path, lines.get(e, 0)) for e in edges}))
+    return sorted(out, key=lambda v: (v.line, v.rule))
+
+
+# -- suppressions ----------------------------------------------------------
+
+
+_SUPP_RE = re.compile(
+    r"^(?P<path>[^:#\s]+):(?P<rule>GT\d{3})\s*=\s*(?P<count>\d+)"
+    r"\s*(#\s*(?P<reason>.*))?$")
+
+
+def load_suppressions(path: Optional[str] = None
+                      ) -> Dict[Tuple[str, str], Tuple[int, str]]:
+    """Parse the ratchet file: ``(file, rule) -> (budget, reason)``."""
+    path = path or _DEFAULT_SUPPRESSIONS
+    out: Dict[Tuple[str, str], Tuple[int, str]] = {}
+    if not os.path.exists(path):
+        return out
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            m = _SUPP_RE.match(line)
+            if m is None:
+                raise ValueError(
+                    f"{path}:{i}: malformed suppression {line!r} — "
+                    f"expected 'path:GTxxx = N  # reason'")
+            key = (m["path"], m["rule"])
+            out[key] = (int(m["count"]), (m["reason"] or "").strip())
+    return out
+
+
+def apply_suppressions(violations: Sequence[LintViolation],
+                       suppressions: Dict[Tuple[str, str],
+                                          Tuple[int, str]]):
+    """Budget accounting: returns ``(unsuppressed, ratchet_notes)``.
+    Violations beyond a (file, rule) budget stay; budgets larger than
+    the observed count produce a ratchet note so the file only
+    tightens."""
+    by_key: Dict[Tuple[str, str], List[LintViolation]] = {}
+    for v in violations:
+        by_key.setdefault((v.file, v.rule), []).append(v)
+    unsuppressed: List[LintViolation] = []
+    for key, vs in sorted(by_key.items()):
+        budget, _ = suppressions.get(key, (0, ""))
+        unsuppressed.extend(vs[budget:])
+    notes: List[str] = []
+    for (file, rule), (budget, reason) in sorted(suppressions.items()):
+        actual = len(by_key.get((file, rule), []))
+        if actual < budget:
+            notes.append(
+                f"ratchet: {file}:{rule} budget {budget} but only "
+                f"{actual} found — lower the budget")
+    return unsuppressed, notes

@@ -144,7 +144,10 @@ def build_dataset_small(
     # (reference example/nanogpt.py offers the same dataset choice);
     # "docs" = REAL English prose from installed package documentation —
     # char-level, fully offline (gym_tpu/data/offline.py)
-    assert dataset in ("shakespeare", "wikitext", "code", "docs")
+    if dataset not in ("shakespeare", "wikitext", "code", "docs"):
+        raise ValueError(
+            f"unknown dataset {dataset!r}; expected one of "
+            f"shakespeare/wikitext/code/docs")
     char = dataset in ("shakespeare", "docs")
     cache_dir = os.path.join(data_root,
                              f"{dataset}_char" if char else dataset)

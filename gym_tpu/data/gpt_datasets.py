@@ -25,7 +25,10 @@ import numpy as np
 class ContiguousGPTTrainDataset:
     def __init__(self, data: np.ndarray, block_size: int):
         data = np.ascontiguousarray(np.asarray(data))
-        assert data.ndim == 1
+        if data.ndim != 1:
+            raise ValueError(
+                f"ContiguousGPTTrainDataset needs a 1-D token stream, got "
+                f"shape {data.shape}")
         self.data = data
         self.block_size = int(block_size)
 
@@ -47,7 +50,10 @@ class ContiguousGPTTrainDataset:
 class NonContiguousGPTTrainDataset:
     def __init__(self, data: np.ndarray):
         data = np.asarray(data)
-        assert data.ndim == 2
+        if data.ndim != 2:
+            raise ValueError(
+                f"NonContiguousGPTTrainDataset needs [n, block+1] rows, "
+                f"got shape {data.shape}")
         self.data = data
 
     def __len__(self) -> int:

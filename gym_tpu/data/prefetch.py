@@ -38,6 +38,13 @@ _SENTINEL_ERROR = "__prefetch_error__"
 _SENTINEL_DONE = "__prefetch_done__"
 
 
+class PrefetchError(RuntimeError):
+    """The prefetch pipeline broke on the host side: the worker died
+    without reporting a typed error, or the consumer drew past the
+    dispatch schedule. Worker-side exceptions re-raise as themselves;
+    this class covers the pipeline's own invariants."""
+
+
 def _transfers_copy() -> bool:
     """Does ``device_put`` copy host memory (vs aliasing the numpy buffer)?
 
@@ -203,14 +210,14 @@ class HostPrefetcher:
                 break
             except queue.Empty:
                 if not self._thread.is_alive():
-                    raise RuntimeError(
+                    raise PrefetchError(
                         "prefetch worker died without reporting an error")
         tag, batch, state = item
         if tag == _SENTINEL_ERROR:
             self._stop.set()
             raise batch
         if tag == _SENTINEL_DONE:
-            raise RuntimeError("prefetch schedule exhausted")
+            raise PrefetchError("prefetch schedule exhausted")
         self._consumed_state = state
         return batch
 

@@ -24,9 +24,13 @@ class ArrayDataset:
     """Map-style dataset over aligned numpy arrays (fast vectorized take)."""
 
     def __init__(self, *arrays: np.ndarray):
-        assert arrays, "need at least one array"
+        if not arrays:
+            raise ValueError("ArrayDataset needs at least one array")
         n = len(arrays[0])
-        assert all(len(a) == n for a in arrays), "arrays must be aligned"
+        if not all(len(a) == n for a in arrays):
+            raise ValueError(
+                f"ArrayDataset arrays must be aligned: lengths "
+                f"{[len(a) for a in arrays]}")
         self.arrays = tuple(np.asarray(a) for a in arrays)
 
     def __len__(self) -> int:

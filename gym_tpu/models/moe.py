@@ -159,7 +159,8 @@ class MoEMLP(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool) -> Tuple[jnp.ndarray, jnp.ndarray]:
         E, K = self.n_experts, self.topk
-        assert 1 <= K <= E, f"topk={K} must be in [1, n_experts={E}]"
+        if not 1 <= K <= E:
+            raise ValueError(f"topk={K} must be in [1, n_experts={E}]")
         B, T, C = x.shape
         S = B * T
         hid = 4 * C
@@ -168,11 +169,12 @@ class MoEMLP(nn.Module):
         impl = self.moe_impl
         if impl == "auto":
             impl = "einsum" if self.expert_axis else "ragged"
-        assert impl in ("einsum", "ragged", "dense"), impl
-        assert not (impl == "ragged" and self.expert_axis), (
-            "ragged MoE dispatch cannot shard experts (use moe_impl='einsum' "
-            "for expert parallelism)"
-        )
+        if impl not in ("einsum", "ragged", "dense"):
+            raise ValueError(f"unknown moe_impl {impl!r}")
+        if impl == "ragged" and self.expert_axis:
+            raise ValueError(
+                "ragged MoE dispatch cannot shard experts (use "
+                "moe_impl='einsum' for expert parallelism)")
 
         # -- router (f32) --------------------------------------------------
         logits = nn.Dense(

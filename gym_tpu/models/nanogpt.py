@@ -141,7 +141,9 @@ class CausalSelfAttention(nn.Module):
     def __call__(self, x, train: bool):
         cfg = self.config
         b, t, c = x.shape
-        assert c % cfg.n_head == 0
+        if c % cfg.n_head != 0:
+            raise ValueError(
+                f"n_embd {c} not divisible by n_head {cfg.n_head}")
         hd = c // cfg.n_head
         qkv = nn.Dense(3 * c, use_bias=cfg.bias,
                        kernel_init=_init_normal(0.02), name="c_attn")(x)
@@ -315,13 +317,12 @@ class GPT(nn.Module):
         else:
             idx, targets = batch, None
         b, t = idx.shape
-        assert t <= cfg.block_size, (
-            f"sequence length {t} > block_size {cfg.block_size}"
-        )
+        if t > cfg.block_size:
+            raise ValueError(
+                f"sequence length {t} > block_size {cfg.block_size}")
         if cfg.decode:
-            assert cfg.seq_axis is None and targets is None, (
-                "decode mode is single-device, logits-only"
-            )
+            if not (cfg.seq_axis is None and targets is None):
+                raise ValueError("decode mode is single-device, logits-only")
             # per-row position cursor, mirroring the per-row cache cursor
             # in _decode_attend (rows are independent request slots)
             pcache = self.variable("cache", "pos",
@@ -331,9 +332,10 @@ class GPT(nn.Module):
         elif cfg.seq_axis is not None:
             # chunked sequences only see their own K/V under dense/flash —
             # block-diagonal attention that would train silently wrong
-            assert cfg.attn_impl == "ring", (
-                f"seq_axis requires attn_impl='ring', got {cfg.attn_impl!r}"
-            )
+            if cfg.attn_impl != "ring":
+                raise ValueError(
+                    f"seq_axis requires attn_impl='ring', got "
+                    f"{cfg.attn_impl!r}")
             idx, targets, pos_vec = slice_seq_chunk(
                 idx, targets, cfg.seq_axis, layout=cfg.seq_layout)
             pos = pos_vec[None, :]
@@ -400,7 +402,8 @@ def slice_seq_chunk(idx, targets, seq_axis: str, axis: int = 1,
     two sides can never disagree."""
     sp = _axis_size(seq_axis)
     t = idx.shape[axis]
-    assert t % sp == 0, f"seq len {t} not divisible by cp={sp}"
+    if t % sp != 0:
+        raise ValueError(f"seq len {t} not divisible by cp={sp}")
     tl = t // sp
     chunk = jax.lax.axis_index(seq_axis)
     if layout == "zigzag" and tl % 2 == 0 and sp > 1:
@@ -487,7 +490,10 @@ def num_params(params: Any, non_embedding: bool = True) -> int:
 def crop_block_size(params: Any, config: GPTConfig,
                     block_size: int) -> Tuple[Any, GPTConfig]:
     """Shrink the context window by slicing wpe (reference ``:278-289``)."""
-    assert block_size <= config.block_size
+    if block_size > config.block_size:
+        raise ValueError(
+            f"cannot crop block_size {config.block_size} UP to "
+            f"{block_size}")
     new = jax.tree.map(lambda x: x, params)  # shallow copy
     new["wpe"] = {"embedding": params["wpe"]["embedding"][:block_size]}
     return new, dataclasses.replace(config, block_size=block_size)

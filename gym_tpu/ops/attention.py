@@ -70,7 +70,8 @@ def causal_attention(
     """
     if impl == "ring":
         from ..parallel.ring_attention import ring_causal_attention
-        assert seq_axis is not None, "ring attention needs seq_axis"
+        if seq_axis is None:
+            raise ValueError("ring attention needs seq_axis")
         return ring_causal_attention(
             q, k, v, axis_name=seq_axis, dropout_rate=dropout_rate,
             dropout_rng=dropout_rng, deterministic=deterministic,
@@ -82,7 +83,9 @@ def causal_attention(
             q, k, v, dropout_rate=dropout_rate, dropout_rng=dropout_rng,
             deterministic=deterministic,
         )
-    assert impl == "dense", f"unknown attention impl {impl!r}"
+    if impl != "dense":
+        raise ValueError(f"unknown attention impl {impl!r}; expected "
+                         f"ring/flash/dense")
     return dense_causal_attention(
         q, k, v, dropout_rate=dropout_rate, dropout_rng=dropout_rng,
         deterministic=deterministic,

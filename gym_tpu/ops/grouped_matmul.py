@@ -50,9 +50,13 @@ def grouped_dot(x: jax.Array, w: jax.Array, gs: jax.Array) -> jax.Array:
 
 @grouped_dot_p.def_abstract_eval
 def _abstract(x, w, gs):
-    assert x.ndim == 2 and w.ndim == 3 and gs.ndim == 1, (
-        f"grouped_dot shapes: x{x.shape} w{w.shape} gs{gs.shape}")
-    assert x.shape[1] == w.shape[1] and w.shape[0] == gs.shape[0]
+    if not (x.ndim == 2 and w.ndim == 3 and gs.ndim == 1):
+        raise ValueError(
+            f"grouped_dot shapes: x{x.shape} w{w.shape} gs{gs.shape}")
+    if not (x.shape[1] == w.shape[1] and w.shape[0] == gs.shape[0]):
+        raise ValueError(
+            f"grouped_dot dims disagree: x{x.shape} w{w.shape} "
+            f"gs{gs.shape} (need x[1]==w[1] and w[0]==gs[0])")
     return jax.core.ShapedArray((x.shape[0], w.shape[2]), x.dtype)
 
 
@@ -78,8 +82,12 @@ def grouped_outer(x: jax.Array, g: jax.Array, gs: jax.Array) -> jax.Array:
 
 @grouped_outer_p.def_abstract_eval
 def _outer_abstract(x, g, gs):
-    assert x.ndim == 2 and g.ndim == 2 and gs.ndim == 1
-    assert x.shape[0] == g.shape[0]
+    if not (x.ndim == 2 and g.ndim == 2 and gs.ndim == 1):
+        raise ValueError(
+            f"grouped_outer shapes: x{x.shape} g{g.shape} gs{gs.shape}")
+    if x.shape[0] != g.shape[0]:
+        raise ValueError(
+            f"grouped_outer row counts disagree: x{x.shape} g{g.shape}")
     return jax.core.ShapedArray((gs.shape[0], x.shape[1], g.shape[1]),
                                 x.dtype)
 

@@ -122,8 +122,9 @@ class AxisCtx:
         mesh axis (``lax.psum_scatter`` has no batching rule for the
         vmapped vnode factor). Chunk ``i`` lands on axis index ``i``,
         matching ``take_shard``'s linear-index slicing."""
-        assert len(self.axes) == 1, (
-            "reduce_scatter needs the pure mesh node axis (n_virt == 1)")
+        if len(self.axes) != 1:
+            raise ValueError(
+                "reduce_scatter needs the pure mesh node axis (n_virt == 1)")
         return lax.psum_scatter(x, self.axes[0], scatter_dimension=0,
                                 tiled=True)
 

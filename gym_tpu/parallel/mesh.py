@@ -92,10 +92,10 @@ class NodeRuntime:
         P·cp·tp·ep·pp ≤ devices."""
         if devices is None:
             devices = jax.devices()
-        assert len(devices) >= cp * tp * ep * pp, (
-            f"cp={cp}*tp={tp}*ep={ep}*pp={pp} does not fit "
-            f"{len(devices)} devices"
-        )
+        if len(devices) < cp * tp * ep * pp:
+            raise ValueError(
+                f"cp={cp}*tp={tp}*ep={ep}*pp={pp} does not fit "
+                f"{len(devices)} devices")
         n_phys = _largest_divisor_at_most(
             num_nodes, len(devices) // (cp * tp * ep * pp))
         n_virt = num_nodes // n_phys

@@ -91,7 +91,8 @@ def _local_node_map(mesh, process_index: Optional[int] = None):
         return per_mesh[process_index]
     mesh_arr = mesh.devices
     local_devs = [d for d in mesh_devs if d.process_index == process_index]
-    assert local_devs, f"process {process_index} owns no mesh devices"
+    if not local_devs:
+        raise ValueError(f"process {process_index} owns no mesh devices")
     # A batch is sharded over the 'node' (first) mesh axis only and
     # REPLICATED over any cp/tp/ep/pp axes — devices sharing a node-axis
     # coordinate hold the same rows. Map each local device to its node
@@ -126,10 +127,10 @@ def global_batch(runtime, local_tree, process_index: Optional[int] = None):
 
     def build(x):
         x = np.asarray(x)
-        assert x.shape[0] % n_local == 0, (
-            f"local leading axis {x.shape[0]} not divisible by this "
-            f"process's {n_local} node-axis shards"
-        )
+        if x.shape[0] % n_local != 0:
+            raise ValueError(
+                f"local leading axis {x.shape[0]} not divisible by this "
+                f"process's {n_local} node-axis shards")
         per = x.shape[0] // n_local
         k_global = per * runtime.n_phys
         shards = [

@@ -73,11 +73,11 @@ def pipeline_apply(
     double-counting the tied embedding: see
     ``train_node.make_pipeline_train_step``).
     """
-    assert axis_size(axis_name) == n_stages, (
-        f"pipe axis '{axis_name}' has size {axis_size(axis_name)} "
-        f"but n_stages={n_stages}: a mismatch would make the is_last mask "
-        "never fire and the masked psum return silent zeros"
-    )
+    if axis_size(axis_name) != n_stages:
+        raise ValueError(
+            f"pipe axis '{axis_name}' has size {axis_size(axis_name)} "
+            f"but n_stages={n_stages}: a mismatch would make the is_last "
+            f"mask never fire and the masked psum return silent zeros")
     m = xs.shape[0]
     sid = lax.axis_index(axis_name)
     is_first = sid == 0
@@ -145,7 +145,9 @@ def stack_stage_params(per_layer_params: list, n_stages: int) -> Any:
     [S, L/S, ...] — shard axis 0 over the ``pipe`` mesh axis and each
     stage scans axis 1 (`apply_stage_layers`)."""
     n_layer = len(per_layer_params)
-    assert n_layer % n_stages == 0, (n_layer, n_stages)
+    if n_layer % n_stages != 0:
+        raise ValueError(
+            f"n_layer={n_layer} not divisible by n_stages={n_stages}")
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer_params)
     return jax.tree.map(
         lambda x: x.reshape((n_stages, n_layer // n_stages) + x.shape[1:]),

@@ -117,8 +117,9 @@ class PipelinedGPTLossModel:
 
     def __init__(self, config: GPTConfig, n_stages: int,
                  compute_dtype: Optional[Any] = None):
-        assert config.n_layer % n_stages == 0, (
-            f"n_layer={config.n_layer} not divisible by pp={n_stages}")
+        if config.n_layer % n_stages != 0:
+            raise ValueError(
+                f"n_layer={config.n_layer} not divisible by pp={n_stages}")
         # pp × ep: dense and MoE layer trees stack as separate groups;
         # raises unless every stage holds the same local layer pattern
         self.moe_pattern = moe_layer_pattern(config, n_stages)
@@ -126,8 +127,9 @@ class PipelinedGPTLossModel:
             # pp × cp: each stage's attention rings over the 'seq' axis;
             # pipe_loss slices the node's token chunk exactly like
             # GPT.__call__ does under cp
-            assert config.attn_impl == "ring", (
-                "seq_axis under pp requires attn_impl='ring'")
+            if config.attn_impl != "ring":
+                raise ValueError(
+                    "seq_axis under pp requires attn_impl='ring'")
         self.config = config
         self.n_stages = n_stages
         self.compute_dtype = compute_dtype

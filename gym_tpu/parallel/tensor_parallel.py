@@ -48,7 +48,8 @@ def make_tp_mesh(devices=None, dp: Optional[int] = None,
         tp = n if dp is None else n // dp
     if dp is None:
         dp = n // tp
-    assert dp * tp <= n, f"dp={dp}×tp={tp} > {n} devices"
+    if dp * tp > n:
+        raise ValueError(f"dp={dp}×tp={tp} > {n} devices")
     grid = np.asarray(devices[: dp * tp]).reshape(dp, tp)
     return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
 

@@ -49,6 +49,12 @@ from ..utils.resilience import fault_point
 PyTree = Any
 
 
+class NoFreeSlotError(RuntimeError):
+    """``admit()`` was called with every slot occupied — a scheduler bug
+    (the driver must check ``free_slots()`` first). Subclasses
+    ``RuntimeError`` so pre-existing callers keep working."""
+
+
 @dataclasses.dataclass(frozen=True)
 class SamplingParams:
     """Per-request sampling configuration — mirrors ``generate_fast``'s
@@ -310,8 +316,9 @@ class InferenceEngine:
         self.validate(prompt, sp)
         free = self.free_slots()
         if not free:
-            raise RuntimeError("no free slot — admit() requires one "
-                               "(scheduler bug: check free_slots() first)")
+            raise NoFreeSlotError(
+                "no free slot — admit() requires one (scheduler bug: "
+                "check free_slots() first)")
         slot = free[0]
         fault_point("serve.prefill")
         n = len(prompt)

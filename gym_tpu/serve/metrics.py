@@ -265,9 +265,26 @@ class ServeMetrics:
             return head
 
     def sync(self) -> None:
+        # fsync OUTSIDE the lock (lint GT102, the ISSUE-6 concurrency
+        # audit's one genuine finding): this lock serializes the HTTP
+        # handlers' admission-control reads (tokens_per_s_ewma) and the
+        # driver's request_done — holding it across a disk-durability
+        # call let one NFS stall wedge the whole serving plane. flush
+        # stays inside (the csv writer's buffer is lock-protected);
+        # fsync of an fd is safe concurrent with further writes, it may
+        # only persist MORE than this call's rows.
         with self._lock:
+            if self._f.closed:
+                return    # straggler sync after close: drop, like the
+                #           row writers' closed-file guards
             self._f.flush()
-            os.fsync(self._f.fileno())
+            # dup the fd under the lock: a concurrent close() cannot
+            # invalidate (or let the OS reuse) OUR descriptor mid-fsync
+            fd = os.dup(self._f.fileno())
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
 
     def close(self) -> None:
         with self._lock:

@@ -90,6 +90,12 @@ class SlotQuarantinedError(RuntimeError):
     are row-isolated by the model's per-row cache math."""
 
 
+class RequestFailedError(RuntimeError):
+    """Fallback for a request failed with only a string reason (no typed
+    exception was stored) — ``Request.result`` re-raises the stored
+    TYPED exception whenever one exists."""
+
+
 class RequestStatus(enum.Enum):
     QUEUED = "queued"
     RUNNING = "running"
@@ -128,7 +134,8 @@ class Request:
         if self.status is RequestStatus.FAILED:
             if self.exception is not None:
                 raise self.exception
-            raise RuntimeError(f"request {self.id} failed: {self.error}")
+            raise RequestFailedError(
+                f"request {self.id} failed: {self.error}")
         return list(self.tokens)
 
     @property

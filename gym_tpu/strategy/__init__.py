@@ -6,7 +6,7 @@ run over the simulated-node mesh axes. Unlike the reference,
 reference's re-exports — SURVEY §2.1).
 """
 
-from .base import Strategy
+from .base import CollectiveEvent, Strategy, StrategyLifecycleError
 from .communicate_optimize import (CommunicateOptimizeStrategy,
                                    CommunicationModule)
 from .demo import DeMoStrategy
@@ -23,6 +23,8 @@ from .sparta_diloco import SPARTADiLoCoStrategy
 
 __all__ = [
     "Strategy",
+    "StrategyLifecycleError",
+    "CollectiveEvent",
     "OptimSpec",
     "ensure_optim_spec",
     "SimpleReduceStrategy",

@@ -35,6 +35,23 @@ from .schedule import build_lr_scale
 PyTree = Any
 
 
+class StrategyLifecycleError(RuntimeError):
+    """A strategy was used out of order: ``init`` before
+    ``finalize(max_steps)``, or a mesh-layout-dependent strategy (ZeRO
+    sharding, DiLoCo ``shard_outer``) initialized without
+    ``bind_ctx(runtime.ctx)``. Typed so callers and tests can branch on
+    the class instead of matching an ``AssertionError`` string."""
+
+
+def require_finalized(strategy: "Strategy") -> None:
+    """Raise ``StrategyLifecycleError`` unless ``finalize`` ran — every
+    ``Strategy.init`` calls this first."""
+    if not getattr(strategy, "_finalized", False):
+        raise StrategyLifecycleError(
+            f"{type(strategy).__name__}: call strategy.finalize(max_steps) "
+            f"before init")
+
+
 def tree_bytes(tree: PyTree) -> int:
     """Total payload size of a pytree in bytes (static python int)."""
     return int(
@@ -209,6 +226,17 @@ class Strategy(abc.ABC):
         relies on this to reconcile traces with the logged CSV.
         """
         return []
+
+    def comm_cycle_steps(self) -> List[int]:
+        """Host steps forming one full communication cycle — the static
+        trace verifier (``gym_tpu.analysis.trace_check``) reconciles the
+        jaxpr-extracted collective inventory against ``comm_events`` at
+        exactly these steps. Default: one period of the ``H`` gate when
+        the strategy has one (plus the gate's step-0 and wraparound
+        edges), else three consecutive steps. Strategies with a cadence
+        that is not H-shaped (e.g. SPARTA's ``interval``) override."""
+        H = int(getattr(self, "H", 1) or 1)
+        return list(range(0, max(3, H + 2)))
 
     # -- logging helpers --------------------------------------------------
 

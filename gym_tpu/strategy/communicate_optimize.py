@@ -21,7 +21,8 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 import jax.numpy as jnp
 import optax
 
-from .base import CollectiveEvent, PyTree, Strategy, comm_metric
+from .base import (CollectiveEvent, PyTree, Strategy, comm_metric,
+                   require_finalized)
 from .optim import OptimSpec, ensure_optim_spec
 
 
@@ -80,7 +81,7 @@ class CommunicateOptimizeStrategy(Strategy):
         return self
 
     def init(self, params: PyTree) -> PyTree:
-        assert self._finalized, "call strategy.finalize(max_steps) first"
+        require_finalized(self)
         return {
             "opt": self.tx.init(params),
             "modules": [m.init(params) for m in self.communication_modules],

@@ -34,7 +34,8 @@ from ..ops.dct import (codec_for, decode_chunks, dct_matrix, encode_chunks,
                        sparse_decode_chunks)
 from ..ops.topk_compress import (mean_weights, scatter_mean_decode,
                                  topk_compress)
-from .base import CollectiveEvent, PyTree, Strategy, comm_metric
+from .base import (CollectiveEvent, PyTree, Strategy, comm_metric,
+                   require_finalized)
 from .optim import OptimSpec, ensure_optim_spec
 from .sharding import pipe_unwrap, pipe_wrap
 
@@ -131,7 +132,7 @@ class DeMoStrategy(Strategy):
         return codecs, dict(sorted(groups.items()))
 
     def init(self, params: PyTree) -> PyTree:
-        assert self._finalized, "call strategy.finalize(max_steps) first"
+        require_finalized(self)
         # The momentum residual lives PRE-CHUNKED, pooled per tile
         # signature ("{a}x{b}" → [G, a·b]), not in leaf layout: the whole
         # momentum/DCT/top-k/residual pipeline then runs as a handful of

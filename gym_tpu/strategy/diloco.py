@@ -27,7 +27,8 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from .base import CollectiveEvent, PyTree, tree_bytes
+from .base import (CollectiveEvent, PyTree, StrategyLifecycleError,
+                   tree_bytes)
 from .communicate_optimize import (CommunicateOptimizeStrategy,
                                    CommunicationModule)
 from .optim import OptimSpec, ensure_optim_spec
@@ -54,7 +55,9 @@ class DiLoCoCommunicator(CommunicationModule):
         participation: float = 1.0,
         fault_seed: int = 5678,
     ):
-        assert 0.0 < participation <= 1.0, participation
+        if not 0.0 < participation <= 1.0:
+            raise ValueError(
+                f"participation must be in (0, 1], got {participation}")
         if shard_outer and participation < 1.0:
             # a truly failed node could not serve its exclusive master
             # shard for the all_gather reassembly, so the fault model is
@@ -80,10 +83,10 @@ class DiLoCoCommunicator(CommunicationModule):
                 "master": jax.tree.map(jnp.array, params),
                 "outer_opt": self.outer_tx.init(params),
             }
-        assert self._ctx is not None, (
-            "shard_outer=True needs the mesh: pass ctx to make_init_fn "
-            "(the Trainer does) or call strategy.bind_ctx(runtime.ctx)"
-        )
+        if self._ctx is None:
+            raise StrategyLifecycleError(
+                "shard_outer=True needs the mesh: pass ctx to make_init_fn "
+                "(the Trainer does) or call strategy.bind_ctx(runtime.ctx)")
         # init runs inside the node program (NodeRuntime.init_state), so
         # the node index is live and each node keeps only its own slice.
         # Dtype follows the params (sharding.take_shard), so the sharded

@@ -38,7 +38,9 @@ class AveragingCommunicator(CommunicationModule):
 
     def __init__(self, island_size: Optional[int] = None, seed: int = 1234,
                  participation: float = 1.0, fault_seed: int = 5678):
-        assert 0.0 < participation <= 1.0, participation
+        if not 0.0 < participation <= 1.0:
+            raise ValueError(
+                f"participation must be in (0, 1], got {participation}")
         self.island_size = island_size
         self.seed = seed
         self.participation = float(participation)

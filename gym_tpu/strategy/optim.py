@@ -110,7 +110,7 @@ class OptimSpec:
                     optax.add_decayed_weights(float(cfg["weight_decay"])), tx
                 )
             return tx
-        raise AssertionError(self.name)
+        raise ValueError(f"unknown optimizer {self.name!r}")
 
     def config(self) -> Dict[str, Any]:
         return {"optimizer": self.name, **self.kwargs}

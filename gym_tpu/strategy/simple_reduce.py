@@ -14,7 +14,7 @@ from typing import List, Optional, Union
 import optax
 
 from .base import (CollectiveEvent, PyTree, Strategy, comm_metric,
-                   tree_bytes)
+                   require_finalized, tree_bytes)
 from .optim import OptimSpec, ensure_optim_spec
 
 
@@ -34,7 +34,7 @@ class SimpleReduceStrategy(Strategy):
         self.tx = self.optim_spec.build(self._lr_scale)
 
     def init(self, params: PyTree) -> PyTree:
-        assert self._finalized, "call strategy.finalize(max_steps) first"
+        require_finalized(self)
         return {"opt": self.tx.init(params)}
 
     def step(self, grads, params, state, step, ctx):

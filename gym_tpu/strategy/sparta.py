@@ -106,7 +106,9 @@ class SparseCommunicator(CommunicationModule):
 
     def __init__(self, index_selector: IndexSelector, interval: int = 1,
                  participation: float = 1.0, fault_seed: int = 5678):
-        assert 0.0 < participation <= 1.0, participation
+        if not 0.0 < participation <= 1.0:
+            raise ValueError(
+                f"participation must be in (0, 1], got {participation}")
         self.index_selector = index_selector
         # `interval` generalizes the reference's (parsed-but-unused)
         # --sparta_interval flag (SURVEY §5.6): exchange every `interval`
@@ -217,3 +219,10 @@ class SPARTAStrategy(CommunicateOptimizeStrategy):
         )
         self.p_sparta = p_sparta
         self.index_selector = selector
+        self.interval = int(interval)
+
+    def comm_cycle_steps(self):
+        # one full exchange period: the masked bytes change per step
+        # (fresh Bernoulli draw), so verify a couple of realized draws
+        # plus the interval gate's off-steps
+        return list(range(0, max(3, 2 * self.interval + 1)))

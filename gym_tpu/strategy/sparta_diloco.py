@@ -50,6 +50,14 @@ class SPARTADiLoCoStrategy(CommunicateOptimizeStrategy):
         )
         self.p_sparta = p_sparta
         self.H = int(H)
+        self.sparta_interval = int(sparta_interval)
+
+    def comm_cycle_steps(self):
+        # the composed cycle covers one outer (H) period AND a full
+        # sparse-exchange period, so the verifier sees gossip-only
+        # steps, the combined step, and the wraparound edges
+        period = max(self.H, self.sparta_interval)
+        return list(range(0, max(3, period + 2)))
 
     def config(self):
         cfg = super().config()

@@ -35,8 +35,8 @@ import optax
 from jax import lax
 from jax.flatten_util import ravel_pytree
 
-from .base import (CollectiveEvent, PyTree, Strategy, comm_metric,
-                   tree_bytes)
+from .base import (CollectiveEvent, PyTree, Strategy, StrategyLifecycleError,
+                   comm_metric, require_finalized, tree_bytes)
 from .optim import OptimSpec, ensure_optim_spec
 from .sharding import pipe_unwrap, pipe_wrap, shard_size, unshard
 
@@ -57,12 +57,12 @@ class ZeroReduceStrategy(Strategy):
         self.tx = self.optim_spec.build(self._lr_scale)
 
     def init(self, params: PyTree) -> PyTree:
-        assert self._finalized, "call strategy.finalize(max_steps) first"
-        assert self._ctx is not None, (
-            "ZeroReduceStrategy shards optimizer state across the node "
-            "axis and must know the mesh: pass ctx to make_init_fn "
-            "(the Trainer does) or call strategy.bind_ctx(runtime.ctx)."
-        )
+        require_finalized(self)
+        if self._ctx is None:
+            raise StrategyLifecycleError(
+                "ZeroReduceStrategy shards optimizer state across the node "
+                "axis and must know the mesh: pass ctx to make_init_fn "
+                "(the Trainer does) or call strategy.bind_ctx(runtime.ctx).")
         shard = jnp.zeros(
             (shard_size(params, self._ctx.num_nodes),), jnp.float32)
         # under pipeline parallelism the flat moments are slices of THIS

@@ -193,7 +193,8 @@ class Trainer:
         show_progress: bool = True,
         **extra,
     ) -> FitResult:
-        assert strategy is not None, "fit requires a strategy"
+        if strategy is None:
+            raise ValueError("fit requires a strategy")
         if extra:
             raise TypeError(f"Unknown fit() kwargs: {sorted(extra)}")
         # int (and not bool) FIRST: resume=0 must mean "checkpoint step
@@ -229,8 +230,10 @@ class Trainer:
                 stacklevel=2,
             )
         minibatch_size = minibatch_size or batch_size
-        assert batch_size % minibatch_size == 0, \
-            "batch_size must be a multiple of minibatch_size"
+        if batch_size % minibatch_size != 0:
+            raise ValueError(
+                f"batch_size {batch_size} must be a multiple of "
+                f"minibatch_size {minibatch_size}")
         n_micro = batch_size // minibatch_size
         if correlation_interval and num_nodes < 2:
             raise ValueError(
@@ -1003,7 +1006,8 @@ class Trainer:
                         # hang diagnosis, not a preemption; abort loudly
                         # (stacks already on stderr) instead of taking a
                         # graceful checkpoint the grace-exit would tear
-                        raise RuntimeError(
+                        from .utils.resilience import WatchdogTimeoutError
+                        raise WatchdogTimeoutError(
                             f"watchdog timeout in '{wd.fired}' — aborting")
                     preempted = True
                     break

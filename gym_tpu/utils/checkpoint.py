@@ -62,6 +62,19 @@ class CheckpointNotFoundError(RuntimeError):
     has no committed steps, or every committed step is corrupt."""
 
 
+class CheckpointWriteError(RuntimeError):
+    """The async writer thread's save failed after retries; raised on the
+    caller's thread at the next ``save_async``/``wait``. Subclasses
+    ``RuntimeError`` so pre-existing ``except RuntimeError`` callers keep
+    working."""
+
+
+class CheckpointWriterStuckError(RuntimeError):
+    """``close()`` could not join the writer thread — a write is hung
+    (filesystem stall, injected hang); the message carries every
+    thread's stack as evidence."""
+
+
 def restore_params(run_dir: str, step: Optional[int] = None,
                    retry_policy: Optional[RetryPolicy] = None
                    ) -> Tuple[int, PyTree, dict]:
@@ -287,7 +300,8 @@ class CheckpointManager:
     def _raise_writer_error(self) -> None:
         if self._writer_error is not None:
             e, self._writer_error = self._writer_error, None
-            raise RuntimeError("async checkpoint write failed") from e
+            raise CheckpointWriteError(
+                "async checkpoint write failed") from e
 
     # -- reads / lifecycle ------------------------------------------------
 
@@ -424,7 +438,7 @@ class CheckpointManager:
                 # A silently leaked writer thread means a write is hung
                 # (filesystem stall, injected hang) — fail loudly with
                 # the evidence rather than pretend the close succeeded.
-                raise RuntimeError(
+                raise CheckpointWriterStuckError(
                     f"checkpoint writer thread still alive after "
                     f"{self._close_timeout:.0f}s close timeout — a write "
                     f"is hung\n" + dump_thread_stacks("thread stacks:"))

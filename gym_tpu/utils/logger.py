@@ -30,7 +30,9 @@ class Logger:
         self.max_steps = max_steps
         self.step = 0
         self.cum_comm_bytes = 0.0
-        self._t0 = time.time()
+        # perf_counter, not time.time: steps_per_second is a DURATION
+        # metric and the wall clock steps under NTP
+        self._t0 = time.perf_counter()
         self.pbar = (
             tqdm(total=max_steps, dynamic_ncols=True)
             if (show_progress and tqdm is not None)
@@ -98,7 +100,7 @@ class Logger:
 
     @property
     def steps_per_second(self) -> float:
-        dt = time.time() - self._t0
+        dt = time.perf_counter() - self._t0
         return self.step / dt if dt > 0 else 0.0
 
 

@@ -80,6 +80,12 @@ class InjectedFault(OSError):
     but distinguishable in test assertions."""
 
 
+class WatchdogTimeoutError(RuntimeError):
+    """A watchdog-protected region exceeded its deadline and the run is
+    being failed loudly (thread stacks already dumped to stderr). Typed
+    so callers distinguish a diagnosed hang from an ordinary error."""
+
+
 @dataclasses.dataclass
 class _Rule:
     site: str

@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# Static-analysis gate (ISSUE 6) — the analysis unit suite plus the CLI
+# over the real package, run NEXT TO ci_tier1/ci_faults/ci_sim/ci_serve/
+# ci_chaos. The unit suite pins the walker/auditor/linter semantics on
+# crafted programs and snippets; the CLI run proves the shipped tree is
+# clean end to end: jaxpr audit (zero unconsumed donations, zero
+# hot-path host callbacks, zero f64 upcasts for trainer + engine
+# programs), static comm reconciliation for all 7 strategies, and the
+# host-concurrency lint with zero unsuppressed violations. Pure host
+# work — nothing is compiled or executed on a device; <90 s on the
+# 2-core container.
+#
+# Usage: scripts/ci_analyze.sh   (from the repo root or anywhere)
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+rm -f /tmp/_analyze.log
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_analysis.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_analyze.log
+rc=${PIPESTATUS[0]}
+echo ANALYZE_DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' \
+    /tmp/_analyze.log | tr -cd . | wc -c)
+[ "$rc" -ne 0 ] && exit "$rc"
+
+# CLI over the real package: machine-readable summary, grep the gate.
+OUT=${GYM_TPU_CI_ANALYZE_OUT:-/tmp/gym_tpu_ci_analysis.json}
+rm -f "$OUT"
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m gym_tpu.analysis \
+    --json "$OUT"
+rc=$?
+[ "$rc" -ne 0 ] && { echo "ci_analyze: CLI reported violations"; exit "$rc"; }
+python - "$OUT" <<'EOF'
+import json, sys
+report = json.load(open(sys.argv[1]))
+assert report["violations"] == 0, report
+sections = report["sections"]
+assert set(sections) == {"lint", "trace", "audit"}
+for name, summ in sections["trace"]["strategies"].items():
+    assert summ["ok"], (name, summ)
+assert len(sections["trace"]["strategies"]) >= 8
+assert len(sections["audit"]["programs"]) >= 12
+print("ci_analyze: violations=0 across",
+      len(sections["trace"]["strategies"]), "strategy configs and",
+      len(sections["audit"]["programs"]), "programs")
+EOF
+rc=$?
+[ "$rc" -ne 0 ] && exit "$rc"
+echo "ci_analyze: OK (report at $OUT)"
+exit 0

@@ -318,7 +318,8 @@ def test_zero_reduce_canonical_matches_vnode_schedule():
 def test_zero_reduce_requires_ctx():
     strat = ZeroReduceStrategy(optim_spec=OptimSpec("sgd", lr=0.1))
     strat.finalize(10)
-    with pytest.raises(AssertionError, match="bind_ctx"):
+    from gym_tpu.strategy.base import StrategyLifecycleError
+    with pytest.raises(StrategyLifecycleError, match="bind_ctx"):
         strat.init({"w": jnp.zeros((4,))})
 
 
