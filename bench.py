@@ -530,8 +530,76 @@ def measure_serving() -> dict:
     eng_cold = timed(run_engine)
     seq_warm = timed(run_sequential)
     eng_warm = timed(run_engine)
+
+    # ---- shared-prefix workload (ISSUE 7): paged prefix-shared KV vs
+    # the PR-4 per-slot engine on the realistic chatbot/agent shape —
+    # N requests dominated by one long common system prompt. The paged
+    # engine prefills the shared blocks ONCE and admits the rest
+    # through the prefix cache; the PR-4 engine re-prefills the full
+    # prompt every time. Aggregate tok/s and p99 TTFT are the headline;
+    # the structural assert is that prefill WORK (padded tokens
+    # dispatched) drops.
+    n_shared = int(os.environ.get("GYM_TPU_BENCH_SERVE_SHARED_REQS", 12))
+    sys_len, tail_len, shared_mnew = 224, 8, 8
+    shared_sys = rng.integers(0, cfg.vocab_size, sys_len)
+    shared_workload = [
+        (np.concatenate([shared_sys,
+                         rng.integers(0, cfg.vocab_size, tail_len)]),
+         SamplingParams(max_new_tokens=shared_mnew, temperature=0.9,
+                        top_k=16, seed=500 + i))
+        for i in range(n_shared)]
+    shared_new = sum(sp.max_new_tokens for _, sp in shared_workload)
+
+    def shared_arm(paged: bool, spec: int = 0) -> dict:
+        def mk():
+            return InferenceEngine(params, cfg, num_slots=num_slots,
+                                   decode_chunk=chunk, paged=paged,
+                                   page_size=16, spec_tokens=spec)
+
+        def serve(sched, wl):
+            handles = [sched.submit(p, sp) for p, sp in wl]
+            while any(h.status.value in ("queued", "running")
+                      for h in handles):
+                sched.step()
+            for h in handles:
+                assert len(h.result()) == h.sampling.max_new_tokens
+            return handles
+
+        # compile pass on a THROWAWAY engine: the measured burst must
+        # meet a COLD prefix cache (first request pays the full
+        # prefill) but warm programs — the global LRUs carry them over
+        serve(Scheduler(mk(), max_queue=n_shared), shared_workload[:2])
+        eng = mk()
+        sched = Scheduler(eng, max_queue=n_shared)
+        t0 = time.perf_counter()
+        handles = serve(sched, shared_workload)
+        wall = time.perf_counter() - t0
+        ttfts = [h.ttft_s for h in handles]
+        out = {
+            "tok_s": round(shared_new / wall, 1),
+            "p50_ttft_s": round(float(np.percentile(ttfts, 50)), 4),
+            "p99_ttft_s": round(float(np.percentile(ttfts, 99)), 4),
+            "prefills": eng.stats.prefills,
+            "prefill_tokens": eng.stats.prefill_tokens,
+            "prefix_hit_blocks": eng.stats.prefix_hit_blocks,
+        }
+        if spec:
+            out["spec_accept_rate"] = eng.stats.spec_accept_rate()
+        return out
+
+    pr4_arm = shared_arm(paged=False)
+    paged_arm = shared_arm(paged=True)
+    spec_arm = shared_arm(paged=True, spec=4)
+    # structural acceptance (ISSUE 7): the shared blocks are measurably
+    # ELIDED from prefill dispatch work, not just faster by luck
+    assert paged_arm["prefill_tokens"] < pr4_arm["prefill_tokens"], (
+        paged_arm, pr4_arm)
+    assert paged_arm["prefix_hit_blocks"] > 0, paged_arm
+
     return {
         "metric": "serving_continuous_batching_vs_sequential_tokens_per_s",
+        "status": "measured",
+        "measured": True,
         "workload": (f"{n_req} requests, distinct (prompt_len in [4,48), "
                      f"max_new in [8,40)) signatures, gpt "
                      f"{cfg.n_layer}L/{cfg.n_embd}d block "
@@ -547,6 +615,22 @@ def measure_serving() -> dict:
         "sequential_programs_compiled": len(workload),
         "engine_prefill_compiles": engine.stats.prefill_compiles,
         "prefill_bound": (cfg.block_size - 1).bit_length() + 1,
+        "shared_prefix": {
+            "workload": (f"{n_shared} requests = {sys_len}-token shared "
+                         f"system prompt + {tail_len}-token distinct "
+                         f"tail, max_new {shared_mnew}, page 16, "
+                         f"{num_slots} slots, chunk {chunk}; programs "
+                         f"warm, prefix cache cold"),
+            "pr4_engine": pr4_arm,
+            "paged_engine": paged_arm,
+            "paged_spec_engine": spec_arm,
+            "tok_s_speedup": round(paged_arm["tok_s"] / pr4_arm["tok_s"],
+                                   2),
+            "p99_ttft_speedup": round(
+                pr4_arm["p99_ttft_s"] / paged_arm["p99_ttft_s"], 2),
+            "prefill_tokens_elided": (pr4_arm["prefill_tokens"]
+                                      - paged_arm["prefill_tokens"]),
+        },
     }
 
 

@@ -1,10 +1,12 @@
 """Jaxpr program auditor: donation, host callbacks, f64, program keys.
 
 Every compiled program the repo ships — the trainer step for each
-strategy (the function ``NodeRuntime.compile`` jits under ``shard_map``)
-and the serving engine's bucketed prefill / admit / fused
-``decode_chunk`` programs — is abstractly traced (never compiled or
-executed) and checked:
+strategy (the function ``NodeRuntime.compile`` jits under ``shard_map``),
+the serving engine's bucketed prefill / admit / fused ``decode_chunk``
+programs, and the paged-KV family (prefix-aware paged prefill,
+copy-on-write page copy, paged decode, fused draft+verify speculative
+decode) — is abstractly traced (never compiled or executed) and
+checked:
 
 - **Donation** — an argument donated via ``donate_argnums`` whose buffer
   XLA cannot alias to an output (no output with the same shape/dtype
@@ -354,6 +356,97 @@ def engine_program_specs(num_slots: int = 2, decode_chunk: int = 4,
         config={"config": cfg_tuple, "num_slots": num_slots,
                 "decode_chunk": decode_chunk},
         family="serve.decode"))
+    specs.extend(paged_program_specs(num_slots=num_slots,
+                                     decode_chunk=decode_chunk,
+                                     buckets=buckets))
+    return specs
+
+
+def paged_program_specs(num_slots: int = 2, decode_chunk: int = 4,
+                        buckets: Sequence[int] = (8, 32),
+                        page_size: int = 8, gamma: int = 4
+                        ) -> List[ProgramSpec]:
+    """The paged-KV/speculative program families (ISSUE 7), traced
+    exactly as the engine jits them: prefix-aware paged prefill (per
+    bucket), the copy-on-write page copy, the paged ``decode_chunk``
+    scan, and the fused draft+verify speculative program. All four
+    DONATE the page-pool cache — it is the multi-MB buffer threaded
+    linearly through every dispatch."""
+    import dataclasses as _dc
+
+    from ..models.nanogpt import GPT, decode_config
+    from ..serve.engine import (_cow_program, _paged_decode_program,
+                                _paged_prefill_program,
+                                _spec_decode_program)
+
+    base = decode_config(_tiny_gpt_config())
+    mb = base.block_size // page_size
+    kv_pages = 2 + num_slots * mb
+    cfg = _dc.replace(base, page_size=page_size, kv_pages=kv_pages)
+    cfg_tuple = _dc.astuple(cfg)
+    model = GPT(cfg)
+
+    pool_tpl = jax.eval_shape(
+        lambda: model.init(
+            {"params": jax.random.PRNGKey(0)},
+            jax.numpy.zeros((num_slots, 1), np.int32), train=False,
+            block_table=jax.numpy.zeros((num_slots, mb), np.int32),
+            cache_pos=jax.numpy.zeros((num_slots,), np.int32)))
+    params_tpl = pool_tpl["params"]
+    pool_tpl = pool_tpl["cache"]
+
+    scalar = lambda dt: jax.ShapeDtypeStruct((), dt)  # noqa: E731
+    vec = lambda dt: jax.ShapeDtypeStruct((num_slots,), dt)  # noqa: E731
+    bt_row = jax.ShapeDtypeStruct((1, mb), np.int32)
+    bt = jax.ShapeDtypeStruct((num_slots, mb), np.int32)
+    hist = jax.ShapeDtypeStruct((num_slots, base.block_size), np.int32)
+    key_t = jax.ShapeDtypeStruct((2,), np.uint32)
+    pcfg = {"config": cfg_tuple, "page_size": page_size,
+            "kv_pages": kv_pages}
+
+    specs: List[ProgramSpec] = []
+    for bucket in buckets:
+        prefill = _paged_prefill_program(cfg_tuple, int(bucket))
+        specs.append(ProgramSpec(
+            name=f"serve.paged_prefill[bucket={bucket}]", fn=prefill,
+            args=(params_tpl, pool_tpl, bt_row,
+                  jax.ShapeDtypeStruct((1,), np.int32),
+                  jax.ShapeDtypeStruct((1, int(bucket)), np.int32),
+                  scalar(np.int32), key_t, scalar(np.float32),
+                  scalar(np.int32), scalar(np.float32)),
+            donate_args=(1,), config={**pcfg, "bucket": bucket},
+            family="serve.paged_prefill"))
+    specs.append(ProgramSpec(
+        name=f"serve.cow[page={page_size}]", fn=_cow_program(cfg_tuple),
+        args=(pool_tpl, scalar(np.int32), scalar(np.int32)),
+        donate_args=(0,), config=pcfg, family="serve.cow"))
+    specs.append(ProgramSpec(
+        name=f"serve.paged_decode[slots={num_slots},"
+             f"chunk={decode_chunk}]",
+        fn=_paged_decode_program(cfg_tuple, num_slots, decode_chunk),
+        args=(params_tpl, pool_tpl, bt, vec(np.int32), vec(np.bool_),
+              vec(np.int32),
+              jax.ShapeDtypeStruct((num_slots, 2), np.uint32),
+              vec(np.int32), vec(np.int32), vec(np.int32),
+              vec(np.float32), vec(np.int32), vec(np.float32)),
+        donate_args=(1,),
+        config={**pcfg, "num_slots": num_slots,
+                "decode_chunk": decode_chunk},
+        family="serve.paged_decode"))
+    specs.append(ProgramSpec(
+        name=f"serve.spec_decode[slots={num_slots},chunk={decode_chunk},"
+             f"gamma={gamma}]",
+        fn=_spec_decode_program(cfg_tuple, num_slots, decode_chunk,
+                                gamma),
+        args=(params_tpl, pool_tpl, bt, hist, vec(np.int32),
+              vec(np.bool_), vec(np.int32),
+              jax.ShapeDtypeStruct((num_slots, 2), np.uint32),
+              vec(np.int32), vec(np.int32), vec(np.int32),
+              vec(np.float32), vec(np.int32), vec(np.float32)),
+        donate_args=(1,),
+        config={**pcfg, "num_slots": num_slots,
+                "decode_chunk": decode_chunk, "gamma": gamma},
+        family="serve.spec_decode"))
     return specs
 
 

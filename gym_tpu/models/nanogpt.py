@@ -87,6 +87,17 @@ class GPTConfig:
     # of new tokens, appends K/V to a per-layer cache ('cache' collection),
     # and attends over cache+chunk — O(T) per new token instead of O(T²).
     decode: bool = False
+    # PagedAttention-style decode cache (arXiv 2309.06180): with
+    # page_size > 0 (decode mode only) each layer's K/V live in a POOL of
+    # `kv_pages` fixed-size pages shared by every batch row, addressed
+    # through a per-row block table of physical page ids passed into
+    # __call__ (`block_table` [b, block_size//page_size], `cache_pos`
+    # [b]). Rows whose tables share page ids share K/V copy-free — the
+    # serving engine's prefix cache (gym_tpu/serve/engine.py) builds on
+    # exactly this. Page 0 is reserved as the NULL page: writes of
+    # deactivated/overflowing rows are redirected there and never read.
+    page_size: int = 0
+    kv_pages: int = 0
 
     def is_moe_layer(self, i: int) -> bool:
         return self.n_experts > 0 and i % self.moe_every == self.moe_every - 1
@@ -138,7 +149,7 @@ class CausalSelfAttention(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, x, train: bool):
+    def __call__(self, x, train: bool, block_table=None, cache_pos=None):
         cfg = self.config
         b, t, c = x.shape
         if c % cfg.n_head != 0:
@@ -150,7 +161,11 @@ class CausalSelfAttention(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         if cfg.decode:
-            y = self._decode_attend(q, k, v, b, t, hd)
+            if cfg.page_size > 0:
+                y = self._decode_attend_paged(q, k, v, b, t, hd,
+                                              block_table, cache_pos)
+            else:
+                y = self._decode_attend(q, k, v, b, t, hd)
             y = nn.Dense(c, use_bias=cfg.bias,
                          kernel_init=_init_normal(
                              0.02 / math.sqrt(2 * cfg.n_layer)),
@@ -239,6 +254,82 @@ class CausalSelfAttention(nn.Module):
         y = jnp.where(ok, y, jnp.nan)
         return y.reshape(b, t, H * hd)
 
+    def _decode_attend_paged(self, q, k, v, b, t, hd, block_table,
+                             cache_pos):
+        """PagedAttention-style KV-cache attention: each layer's K/V live
+        in a POOL of ``kv_pages`` fixed-size pages shared by every row;
+        ``block_table`` [b, block_size//page_size] maps a row's logical
+        blocks to physical page ids and ``cache_pos`` [b] is the row's
+        cache cursor (both are ARGUMENTS, not cache variables — the
+        engine owns allocation and cursor advance; the cache collection
+        holds only the batch-shape-independent pools, so a 1-row prefill
+        and an S-row decode run against the SAME buffers).
+
+        Rows whose tables reference the same pages share K/V copy-free —
+        the basis of prefix sharing. Invariants the caller (the serving
+        engine) maintains: written blocks are uniquely owned (shared
+        pages are full, read-only prefix blocks), and deactivated rows'
+        tables are redirected to the NULL page 0. Writes at positions
+        past ``block_size`` (speculative drafts near the window edge) go
+        to the null page and their query outputs are NaN-poisoned
+        PER POSITION — an emitted token can never come from an
+        out-of-window position, while in-window positions of the same
+        row stay clean."""
+        cfg = self.config
+        H, page, P = cfg.n_head, cfg.page_size, cfg.kv_pages
+        S = cfg.block_size
+        if S % page != 0:
+            raise ValueError(
+                f"block_size {S} not divisible by page_size {page}")
+        if block_table is None or cache_pos is None:
+            raise ValueError(
+                "paged decode (page_size > 0) needs block_table and "
+                "cache_pos passed to __call__")
+        mb = S // page
+
+        def heads(z):
+            return z.reshape(b, t, H, hd)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        ck = self.variable("cache", "k",
+                           lambda: jnp.zeros((P, page, H, hd), q.dtype))
+        cv = self.variable("cache", "v",
+                           lambda: jnp.zeros((P, page, H, hd), q.dtype))
+        i = cache_pos                                   # [b] per-row cursor
+        wpos = i[:, None] + jnp.arange(t)[None, :]      # [b, t] write pos
+        lblk = jnp.clip(wpos // page, 0, mb - 1)
+        phys = jnp.take_along_axis(block_table, lblk, axis=1)  # [b, t]
+        # out-of-window writes land on the null page (never read) so they
+        # cannot corrupt a live page; the positions are poisoned below
+        phys = jnp.where(wpos < S, phys, 0)
+        off = wpos % page
+        k_pool = ck.value.at[phys, off].set(k)
+        v_pool = cv.value.at[phys, off].set(v)
+        ck.value, cv.value = k_pool, v_pool
+
+        # gather each row's pages back into its logical [S] window and
+        # attend exactly like the unpaged path: the reductions run over
+        # the same static S axis with the same masks, which is what keeps
+        # paged token streams bit-identical to the unpaged engine and
+        # generate_fast
+        k_all = k_pool[block_table].reshape(b, S, H, hd)
+        v_all = v_pool[block_table].reshape(b, S, H, hd)
+        att = jnp.einsum("bqhd,bkhd->bhqk", q, k_all) / math.sqrt(hd)
+        col_pos = jnp.arange(S)                         # [S]
+        mask = col_pos[None, None, :] <= wpos[:, :, None]   # [b, t, S]
+        att = jnp.where(mask[:, None], att.astype(jnp.float32),
+                        -jnp.inf)
+        att = jax.nn.softmax(att, axis=-1).astype(q.dtype)
+        y = jnp.einsum("bhqk,bkhd->bqhd", att, v_all)
+        # per-POSITION poison (vs the unpaged path's per-row check): a
+        # speculative verify may legally write drafts past the window —
+        # those drafts are rejected before emission, so only the
+        # out-of-window positions go NaN and the row's in-window tokens
+        # stay clean
+        ok = (wpos < S)[:, :, None, None]
+        y = jnp.where(ok, y, jnp.nan)
+        return y.reshape(b, t, H * hd)
+
 
 class MLP(nn.Module):
     config: GPTConfig
@@ -259,10 +350,11 @@ class Block(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, x, train: bool):
+    def __call__(self, x, train: bool, block_table=None, cache_pos=None):
         cfg = self.config
         x = x + CausalSelfAttention(cfg, name="attn")(
-            nn.LayerNorm(epsilon=1e-5, use_bias=cfg.bias, name="ln_1")(x), train
+            nn.LayerNorm(epsilon=1e-5, use_bias=cfg.bias, name="ln_1")(x),
+            train, block_table=block_table, cache_pos=cache_pos
         )
         x = x + MLP(cfg, name="mlp")(
             nn.LayerNorm(epsilon=1e-5, use_bias=cfg.bias, name="ln_2")(x), train
@@ -277,12 +369,13 @@ class MoEBlock(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, x, train: bool):
+    def __call__(self, x, train: bool, block_table=None, cache_pos=None):
         cfg = self.config
         from .moe import MoEMLP
 
         x = x + CausalSelfAttention(cfg, name="attn")(
-            nn.LayerNorm(epsilon=1e-5, use_bias=cfg.bias, name="ln_1")(x), train
+            nn.LayerNorm(epsilon=1e-5, use_bias=cfg.bias, name="ln_1")(x),
+            train, block_table=block_table, cache_pos=cache_pos
         )
         y, aux = MoEMLP(
             n_embd=cfg.n_embd, n_layer=cfg.n_layer, n_experts=cfg.n_experts,
@@ -310,7 +403,8 @@ class GPT(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, batch, train: bool = True):
+    def __call__(self, batch, train: bool = True, block_table=None,
+                 cache_pos=None):
         cfg = self.config
         if isinstance(batch, (tuple, list)):
             idx, targets = batch
@@ -323,12 +417,26 @@ class GPT(nn.Module):
         if cfg.decode:
             if not (cfg.seq_axis is None and targets is None):
                 raise ValueError("decode mode is single-device, logits-only")
-            # per-row position cursor, mirroring the per-row cache cursor
-            # in _decode_attend (rows are independent request slots)
-            pcache = self.variable("cache", "pos",
-                                   lambda: jnp.zeros((b,), jnp.int32))
-            pos = pcache.value[:, None] + jnp.arange(t)[None, :]
-            pcache.value = pcache.value + t
+            if cfg.page_size > 0:
+                # paged decode: the cursor is an ARGUMENT, not cache
+                # state — the engine owns allocation and cursor advance
+                # (speculative rollback is a host-side cursor rewind)
+                if cache_pos is None:
+                    raise ValueError(
+                        "paged decode (page_size > 0) needs cache_pos")
+                # clamp for the wpe gather: out-of-window speculative
+                # positions are NaN-poisoned in the attend, never emitted
+                pos = jnp.minimum(
+                    cache_pos[:, None] + jnp.arange(t)[None, :],
+                    cfg.block_size - 1)
+            else:
+                # per-row position cursor, mirroring the per-row cache
+                # cursor in _decode_attend (rows are independent request
+                # slots)
+                pcache = self.variable("cache", "pos",
+                                       lambda: jnp.zeros((b,), jnp.int32))
+                pos = pcache.value[:, None] + jnp.arange(t)[None, :]
+                pcache.value = pcache.value + t
         elif cfg.seq_axis is not None:
             # chunked sequences only see their own K/V under dense/flash —
             # block-diagonal attention that would train silently wrong
@@ -352,12 +460,17 @@ class GPT(nn.Module):
         moe_cls = (nn.remat(MoEBlock, static_argnums=(2,)) if cfg.remat
                    else MoEBlock)
         aux_total = jnp.zeros((), jnp.float32)
+        # paged-decode addressing rides down to every attention layer;
+        # passed only when active so the training/unpaged traces (incl.
+        # the remat-wrapped positional signature) are untouched
+        kw = ({"block_table": block_table, "cache_pos": cache_pos}
+              if cfg.decode and cfg.page_size > 0 else {})
         for i in range(cfg.n_layer):
             if cfg.is_moe_layer(i):
-                x, aux = moe_cls(cfg, name=f"h_{i}")(x, train)
+                x, aux = moe_cls(cfg, name=f"h_{i}")(x, train, **kw)
                 aux_total = aux_total + aux
             else:
-                x = block_cls(cfg, name=f"h_{i}")(x, train)
+                x = block_cls(cfg, name=f"h_{i}")(x, train, **kw)
         x = nn.LayerNorm(epsilon=1e-5, use_bias=cfg.bias, name="ln_f")(x)
         if targets is None:
             # weight tying: lm_head = wteᵀ (reference :206-208)

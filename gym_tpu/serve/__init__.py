@@ -14,12 +14,18 @@ concurrent load:
   bucketed to powers of two so total compilations are bounded by
   ``O(log block_size)`` instead of one per prompt length. Requests enter
   free slots and leave on EOS/max-tokens BETWEEN decode steps —
-  continuous batching, no drain-the-batch barrier.
+  continuous batching, no drain-the-batch barrier. With ``paged=True``
+  the KV cache becomes a shared PAGE POOL with per-slot block tables, a
+  ref-counted allocator and a prefix hash table: block-aligned shared
+  prompt prefixes are prefilled once and reused copy-free across
+  requests, and ``spec_tokens=γ`` adds self-drafting speculative
+  decoding whose token streams are EXACTLY the non-speculative ones.
 - ``scheduler``: FCFS request queue, slot assignment, and a
   backpressure-bounded submit/poll API — with per-request deadlines
   (queued requests past deadline shed before prefill, running ones
-  cancelled at chunk boundaries) and EWMA-based admission control
-  (infeasible deadlines rejected typed before they are enqueued).
+  cancelled at chunk boundaries), EWMA-based admission control
+  (infeasible deadlines rejected typed before they are enqueued) and
+  prefix-aware admit ordering over a bounded lookahead window.
 - ``supervisor``: self-healing driver loop — every dispatch runs under a
   watchdog; an engine crash or wedge fails in-flight requests typed,
   rebuilds the engine warm (global program LRUs) and resumes the queue.
@@ -32,7 +38,8 @@ concurrent load:
   stdlib-HTTP entrypoint with graceful SIGTERM drain.
 """
 
-from .engine import EngineStats, InferenceEngine, SamplingParams
+from .engine import (BlockAllocator, EngineStats, InferenceEngine,
+                     NoFreeBlocksError, SamplingParams)
 from .load import load_for_serving
 from .metrics import ServeMetrics
 from .scheduler import (AdmissionRejectedError, DeadlineExceededError,
@@ -43,6 +50,7 @@ from .supervisor import Supervisor
 
 __all__ = [
     "InferenceEngine", "SamplingParams", "EngineStats",
+    "BlockAllocator", "NoFreeBlocksError",
     "Scheduler", "Request", "RequestStatus", "QueueFullError",
     "SchedulerClosedError", "DeadlineExceededError",
     "AdmissionRejectedError", "EngineFailedError",

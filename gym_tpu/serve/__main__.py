@@ -83,6 +83,19 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--decode_chunk", type=int, default=1,
                    help="decode steps fused per dispatch (chunk boundary "
                         "= deadline-cancellation granularity)")
+    p.add_argument("--page_size", type=int, default=16,
+                   help="paged KV cache page size in tokens (must divide "
+                        "block_size; 0 reverts to the unpaged per-slot "
+                        "cache). Paging enables copy-free prefix sharing "
+                        "across requests")
+    p.add_argument("--kv_pages", type=int, default=None,
+                   help="physical pages in the paged KV pool (default: "
+                        "null page + num_slots full windows; smaller "
+                        "pools admit lazily as blocks free)")
+    p.add_argument("--spec_tokens", type=int, default=0,
+                   help="speculative decoding draft length γ (0 = off; "
+                        "paged only). Token streams stay exactly equal "
+                        "to non-speculative decoding")
     p.add_argument("--max_queue", type=int, default=64,
                    help="FCFS queue bound (backpressure: submits beyond "
                         "it wait, then 429)")
@@ -149,8 +162,9 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
                   dispatch_timeout: float = 120.0, max_restarts: int = 5,
                   metrics_dir: Optional[str] = None,
                   info: Optional[Dict[str, Any]] = None,
-                  stop_event: Optional[threading.Event] = None
-                  ) -> ServerHandle:
+                  stop_event: Optional[threading.Event] = None,
+                  page_size: int = 16, kv_pages: Optional[int] = None,
+                  spec_tokens: int = 0) -> ServerHandle:
     """Build the full serving stack — engine, scheduler, supervisor,
     metrics, HTTP server — WITHOUT entering ``serve_forever``. ``main``
     and the in-process chaos tests share this path, so what the tests
@@ -173,12 +187,30 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
         import tempfile
         metrics_dir = tempfile.mkdtemp(prefix="gym_tpu_serve_")
 
+    if page_size and cfg.block_size % page_size:
+        # a page size that doesn't divide this checkpoint's window can't
+        # page — serve unpaged rather than refuse the checkpoint
+        sys.stderr.write(
+            f"gym_tpu.serve: page_size {page_size} does not divide "
+            f"block_size {cfg.block_size} — serving unpaged"
+            + (", speculative decoding disabled (it requires the paged "
+               "cache)" if spec_tokens else "") + "\n")
+        page_size = 0
+    paged = page_size > 0
+    if spec_tokens and not paged:
+        sys.stderr.write(
+            "gym_tpu.serve: --spec_tokens requires the paged cache "
+            "(--page_size > 0) — speculative decoding disabled\n")
+
     def engine_factory():
         # the params live in memory (restored from the checkpoint at
         # startup); the global prefill/decode program LRUs make a rebuild
         # warm — same config, no recompiles
         return InferenceEngine(params, cfg, num_slots=num_slots,
-                               decode_chunk=decode_chunk)
+                               decode_chunk=decode_chunk, paged=paged,
+                               page_size=page_size or 16,
+                               kv_pages=kv_pages,
+                               spec_tokens=spec_tokens if paged else 0)
 
     metrics = ServeMetrics(metrics_dir)
     sched = Scheduler(engine_factory(), max_queue=max_queue,
@@ -222,7 +254,10 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
                 self._reply(404, {"error": f"unknown path {self.path}"})
                 return
             s = sched.engine.stats
+            eng = sched.engine
             self._reply(200, {
+                **metrics.headline(),   # first: the LIVE engine stats
+                #                         below win over its tick samples
                 "status": ("draining" if stop.is_set() else
                            "degraded" if sup.failed is not None else "ok"),
                 "step": info["step"],
@@ -233,8 +268,16 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
                 "decode_steps": s.decode_steps,
                 "prefills": s.prefills,
                 "prefill_buckets": list(s.prefill_buckets),
+                "prefill_tokens": s.prefill_tokens,
+                "paged": bool(getattr(eng, "paged", False)),
+                "page_size": int(getattr(eng, "page_size", 0)),
+                "kv_pages": int(getattr(eng, "kv_pages", 0)),
+                "spec_tokens": int(getattr(eng, "spec_tokens", 0)),
+                "kv_blocks_in_use": s.kv_blocks_in_use,
+                "kv_blocks_cached": s.kv_blocks_cached,
+                "prefix_hit_blocks": s.prefix_hit_blocks,
+                "spec_accept_rate": s.spec_accept_rate(),
                 **sup.status(),
-                **metrics.headline(),
             })
 
         def do_POST(self):
@@ -390,7 +433,8 @@ def main(argv=None) -> int:
         dispatch_timeout=getattr(args, "dispatch_timeout"),
         max_restarts=getattr(args, "max_restarts"),
         metrics_dir=args.metrics_dir or os.path.join(args.ckpt, "serve"),
-        info=info, stop_event=stop)
+        info=info, stop_event=stop, page_size=args.page_size,
+        kv_pages=args.kv_pages, spec_tokens=args.spec_tokens)
     httpd, sched, sup, metrics = (handle.httpd, handle.scheduler,
                                   handle.supervisor, handle.metrics)
 
@@ -432,9 +476,13 @@ def main(argv=None) -> int:
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, _on_signal)
 
+    eng = handle.scheduler.engine
+    kv = (f"paged kv: page {eng.page_size} x {eng.kv_pages} pages"
+          + (f", spec {eng.spec_tokens}" if eng.spec_tokens else "")
+          if eng.paged else "unpaged kv")
     print(f"gym_tpu.serve: listening on http://{args.host}:{handle.port} "
-          f"({args.num_slots} slots, queue {args.max_queue}, watchdog "
-          f"{getattr(args, 'dispatch_timeout'):.0f}s)", flush=True)
+          f"({args.num_slots} slots, queue {args.max_queue}, {kv}, "
+          f"watchdog {getattr(args, 'dispatch_timeout'):.0f}s)", flush=True)
     try:
         httpd.serve_forever()
     finally:

@@ -31,6 +31,31 @@ token stream is IDENTICAL to ``generate_fast`` with the same sampling
 config and seed — both use the shared ``sample_logits`` kernel and the
 ``fold_in(PRNGKey(seed), token_index)`` key schedule, and the per-row
 cache math is the same program modulo batch width.
+
+**Paged KV + prefix sharing** (``paged=True``; PagedAttention, arXiv
+2309.06180): the cache becomes a POOL of fixed-size pages addressed
+through a per-slot block table (``models/nanogpt.py:_decode_attend_paged``
+— same static-[block_size] reductions and masks as the unpaged attend,
+which is what keeps paged token streams bit-identical). A ref-counted
+``BlockAllocator`` plus an exact-content prefix hash table admit a
+prompt whose longest block-aligned prefix is already resident WITHOUT
+re-prefilling or copying those blocks: prefill processes only the
+suffix (one bucket-padded dispatch), and a fully-matched final block is
+copy-on-written so its last token can be re-forwarded for the
+first-token logits without perturbing other readers. Blocks a request
+may ever write (suffix pads + the whole decode budget) are reserved at
+admit, so shared pages are full, immutable prompt blocks by
+construction and the jitted programs never need to allocate.
+
+**Speculative decoding** (``spec_tokens=γ``; arXiv 2302.01318), fused
+into the ``decode_chunk`` scan: draft γ tokens per slot by on-device
+n-gram lookup over the slot's token history, verify them in ONE batched
+``γ+1``-token model call, vectorized per-slot accept/reject with a
+cursor-rewind rollback (rejected K/V sit past the cursor in slot-owned
+blocks, masked until overwritten). Every position is sampled from the
+true conditional with the request's own key schedule, so the emitted
+stream equals the non-speculative engine's EXACTLY for every sampling
+configuration — drafts only decide how many samples one dispatch keeps.
 """
 
 from __future__ import annotations
@@ -53,6 +78,16 @@ class NoFreeSlotError(RuntimeError):
     """``admit()`` was called with every slot occupied — a scheduler bug
     (the driver must check ``free_slots()`` first). Subclasses
     ``RuntimeError`` so pre-existing callers keep working."""
+
+
+class NoFreeBlocksError(RuntimeError):
+    """The paged KV pool cannot currently supply enough blocks for this
+    admission. Unlike ``NoFreeSlotError`` this is an EXPECTED transient
+    under load (an undersized pool serving long requests): the scheduler
+    keeps the request queued and retries once running requests release
+    their blocks. ``InferenceEngine.validate`` rejects up front any
+    request whose worst-case block need exceeds the whole pool, so a
+    queued request always eventually fits."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -89,9 +124,27 @@ class EngineStats:
     prefills: int = 0
     prefill_compiles: int = 0            # new bucket programs THIS engine hit
     prefill_buckets: Tuple[int, ...] = ()
+    prefill_tokens: int = 0              # padded tokens dispatched through
+    #                                      prefill — the prefix-sharing
+    #                                      work-elision observable
     active_slots: int = 0
     num_slots: int = 0
     quarantined: int = 0                 # slots shut down on NaN/Inf logits
+    # paged-KV observables (0 on an unpaged engine)
+    kv_blocks_in_use: int = 0            # pages referenced by live slots
+    kv_blocks_cached: int = 0            # resident reusable prefix blocks
+    prefix_hit_blocks: int = 0           # cumulative blocks served from the
+    #                                      prefix cache instead of prefilled
+    # speculative-decoding counters (0 with speculation off)
+    spec_drafted: int = 0
+    spec_accepted: int = 0
+
+    def spec_accept_rate(self) -> Optional[float]:
+        """Accepted / drafted speculative tokens (None before the first
+        draft) — the EWMA-priceable acceptance observable."""
+        if not self.spec_drafted:
+            return None
+        return self.spec_accepted / self.spec_drafted
 
 
 def prompt_bucket(n: int, block_size: int) -> int:
@@ -109,6 +162,136 @@ def max_prefill_buckets(block_size: int) -> int:
     buckets are {1, 2, 4, ..., 2^⌈log2(block_size)⌉ capped} — at most
     ``⌈log2(block_size)⌉ + 1`` of them."""
     return (block_size - 1).bit_length() + 1
+
+
+class BlockAllocator:
+    """Host-side ref-counted page allocator + prefix hash table for the
+    paged KV pool (PagedAttention, arXiv 2309.06180).
+
+    Page ids index the device pools (``[kv_pages, page_size, H, hd]``
+    per layer); page 0 is the reserved NULL page — never allocated,
+    the write-redirect target for deactivated rows. A page's refcount
+    counts ACTIVE slot users; pages holding full, block-aligned PROMPT
+    blocks are additionally content-registered in the prefix cache under
+    an exact chain key ``(parent_chain_id, block_token_bytes)``. The
+    parent id is a monotonically increasing content id — never a page
+    id — so a recycled page can never falsely revalidate a stale child
+    entry. A cached page at refcount 0 stays RESIDENT (that is the
+    point: the next request with the same prefix reuses it copy-free)
+    and is evicted LRU only when the free list runs dry.
+    """
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 2:
+            raise ValueError(
+                f"kv_pages must be >= 2 (null page + one real page), "
+                f"got {num_pages}")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self._free = list(range(num_pages - 1, 0, -1))   # pop() → low ids
+        self._ref: Dict[int, int] = {}
+        # chain key → (page, content id); insertion order is LRU order
+        # (lookup hits refresh recency)
+        from collections import OrderedDict
+        self._cache: "OrderedDict[Tuple[int, bytes], Tuple[int, int]]" = \
+            OrderedDict()
+        self._key_of: Dict[int, Tuple[int, bytes]] = {}
+        self._cid = 0
+
+    # -- observables ------------------------------------------------------
+
+    def in_use(self) -> int:
+        return sum(1 for r in self._ref.values() if r > 0)
+
+    def cached(self) -> int:
+        return len(self._cache)
+
+    def available(self, exclude=()) -> int:
+        """Pages an ``alloc`` burst could obtain right now: the free list
+        plus evictable (refcount-0 cached) pages. ``exclude`` treats the
+        given pages as unavailable — a planned admission must not count
+        the very prefix blocks it is about to pin as evictable slack."""
+        ex = set(exclude)
+        n = len(self._free)
+        for _key, (pg, _cid) in self._cache.items():
+            if self._ref.get(pg, 0) == 0 and pg not in ex:
+                n += 1
+        return n
+
+    # -- allocation -------------------------------------------------------
+
+    def alloc(self) -> int:
+        """Allocate a page at refcount 1, evicting the LRU refcount-0
+        cached page when the free list is empty."""
+        if self._free:
+            pg = self._free.pop()
+        else:
+            pg = self._evict_one()
+        self._ref[pg] = 1
+        return pg
+
+    def _evict_one(self) -> int:
+        for key, (pg, _cid) in self._cache.items():      # oldest first
+            if self._ref.get(pg, 0) == 0:
+                del self._cache[key]
+                del self._key_of[pg]
+                self._ref.pop(pg, None)
+                return pg
+        raise NoFreeBlocksError(
+            f"paged KV pool exhausted: all {self.num_pages - 1} pages "
+            f"are referenced by running requests")
+
+    def incref(self, page: int) -> None:
+        self._ref[page] = self._ref.get(page, 0) + 1
+
+    def decref(self, page: int) -> None:
+        r = self._ref.get(page, 0) - 1
+        if r < 0:
+            raise ValueError(f"page {page} double-freed")
+        self._ref[page] = r
+        if r == 0 and page not in self._key_of:
+            # plain owned page → straight back to the free list; cached
+            # pages stay resident (evictable) for future prefix hits
+            self._ref.pop(page)
+            self._free.append(page)
+
+    # -- prefix cache -----------------------------------------------------
+
+    def lookup(self, parent_cid: int, block: bytes):
+        """Resident ``(page, cid)`` for this chain link, or None. A hit
+        refreshes the entry's LRU recency."""
+        key = (parent_cid, block)
+        ent = self._cache.get(key)
+        if ent is not None:
+            self._cache.move_to_end(key)
+        return ent
+
+    def touch(self, page: int) -> None:
+        """Refresh a cached page's LRU recency by page id — admission
+        commits touch their hit pages so a hot prefix is not the
+        eviction victim just because planning probes never counted."""
+        key = self._key_of.get(page)
+        if key is not None:
+            self._cache.move_to_end(key)
+
+    def probe(self, parent_cid: int, block: bytes):
+        """``lookup`` without the LRU touch — for capacity planning and
+        scheduler ordering probes that may never admit."""
+        return self._cache.get((parent_cid, block))
+
+    def register(self, parent_cid: int, block: bytes, page: int) -> int:
+        """Content-register an owned full prompt block; returns the chain
+        id for the NEXT block's parent. If the key is already cached the
+        existing entry wins (its cid is returned and our page stays a
+        plain owned page) — chains dedupe onto the canonical lineage."""
+        key = (parent_cid, block)
+        ent = self._cache.get(key)
+        if ent is not None:
+            return ent[1]
+        self._cid += 1
+        self._cache[key] = (page, self._cid)
+        self._key_of[page] = key
+        return self._cid
 
 
 # Program caches are GLOBAL (keyed by config/shape signature, like
@@ -213,6 +396,216 @@ def _slot_programs(cfg_tuple, num_slots: int, chunk: int):
     return admit, decode
 
 
+# -- paged-KV programs -----------------------------------------------------
+
+
+@functools.lru_cache(maxsize=64)
+def _paged_prefill_program(cfg_tuple, bucket: int):
+    cfg = GPTConfig(*cfg_tuple)
+    model = GPT(cfg)
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def prefill(params, cache, bt_row, start, tokens, true_suffix, key,
+                temp, top_k, top_p):
+        """Prefix-aware paged prefill: process only the SUFFIX tokens the
+        prefix cache could not supply. ``tokens`` [1, bucket] is the
+        right-padded suffix, ``start`` [1] the first suffix position
+        (= the shared-prefix length; attention gathers the resident
+        prefix K/V through ``bt_row``), ``true_suffix`` its unpadded
+        length. Samples the request's first token (key-schedule index 0)
+        at the true last prompt position and returns it with the updated
+        pool — the pool is DONATED: suffix K/V scatter in place."""
+        logits, varsc = model.apply(
+            {"params": params, "cache": cache}, tokens, train=False,
+            mutable=["cache"], block_table=bt_row, cache_pos=start)
+        last = jax.lax.dynamic_index_in_dim(logits, true_suffix - 1,
+                                            axis=1, keepdims=False)  # [1,V]
+        tok = sample_logits(last, jax.random.fold_in(key, 0),
+                            temp, top_k, top_p)
+        return tok, varsc["cache"]
+
+    return prefill
+
+
+@functools.lru_cache(maxsize=16)
+def _cow_program(cfg_tuple):
+    """Copy page ``src`` → ``dst`` across every layer's K/V pool: the
+    copy-on-write primitive for a shared block that must be appended
+    into (re-forwarding its tokens into the shared page instead would
+    perturb every other reader by the recompute's rounding)."""
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def cow(cache, src, dst):
+        return jax.tree.map(lambda c: c.at[dst].set(c[src]), cache)
+
+    return cow
+
+
+@functools.lru_cache(maxsize=32)
+def _paged_decode_program(cfg_tuple, num_slots: int, chunk: int):
+    """Paged twin of ``_slot_programs``' decode: same fused
+    ``decode_chunk`` scan and on-device lifecycle, but K/V flow through
+    the page pool via each slot's block table and the per-row cursor is
+    explicit carry state (``pos``) instead of a cache variable. Inactive
+    rows have their tables redirected to the NULL page so their garbage
+    writes can never touch a page that was freed and reallocated to a
+    live slot."""
+    cfg = GPTConfig(*cfg_tuple)
+    model = GPT(cfg)
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def decode(params, cache, bt, tok, active, pos, base_keys, gen_idx,
+               remaining, eos, temp, top_k, top_p):
+        def body(carry, _):
+            cache, tok, act, pos, gidx, rem, nanc, _lg = carry
+            bt_eff = jnp.where(act[:, None], bt, 0)
+            logits, varsc = model.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                train=False, mutable=["cache"], block_table=bt_eff,
+                cache_pos=pos)
+            lg = logits[:, 0]                           # [S, V]
+            # quarantine is latched PER ITERATION while the row is
+            # active: the null-page redirect means a finished row's
+            # later iterations read clean garbage, so (unlike the
+            # unpaged program) the LAST step's logits cannot witness a
+            # poison that struck mid-chunk
+            nanc = nanc | (act & ~jnp.isfinite(lg).all(axis=-1))
+            keys = jax.vmap(jax.random.fold_in)(base_keys, gidx)
+            nxt = jax.vmap(sample_logits)(lg, keys, temp, top_k, top_p)
+            nxt = jnp.where(act, nxt, tok).astype(jnp.int32)
+            emitted = act
+            pos = jnp.where(act, pos + 1, pos)
+            gidx = jnp.where(act, gidx + 1, gidx)
+            rem = jnp.where(act, rem - 1, rem)
+            done = act & ((rem <= 0) | ((eos >= 0) & (nxt == eos)))
+            return ((varsc["cache"], nxt, act & ~done, pos, gidx, rem,
+                     nanc, lg), (nxt, emitted))
+
+        lg0 = jnp.zeros((num_slots, cfg.vocab_size), jnp.float32)
+        nan0 = jnp.zeros((num_slots,), bool)
+        (cache, tok, active, pos, gen_idx, remaining, nan_seen, lg), \
+            (toks, emitted) = jax.lax.scan(
+                body, (cache, tok, active, pos, gen_idx, remaining,
+                       nan0, lg0), None, length=chunk)
+        return toks, emitted, lg, tok, active, pos, nan_seen, cache
+
+    return decode
+
+
+def _ngram_draft(hist, hist_len, tok, gamma: int):
+    """Vectorized n-gram (prompt-lookup) drafting: for each slot, find
+    the most recent earlier occurrence of the current BIGRAM
+    ``(hist[len-2], tok)`` in that slot's token history and propose the
+    ``gamma`` tokens that followed it. No match (or a match with no
+    continuation) falls back to repeating ``tok`` — correctness never
+    depends on draft quality, only throughput does: the verify step
+    samples every position from the true conditional with the request's
+    own key schedule, so ANY draft sequence yields the exact
+    non-speculative token stream."""
+    s, length = hist.shape
+    idx = jnp.arange(length - 1)
+    a = jnp.take_along_axis(
+        hist, jnp.clip(hist_len - 2, 0, length - 1)[:, None], axis=1)[:, 0]
+    m = (hist[:, :-1] == a[:, None]) & (hist[:, 1:] == tok[:, None])
+    # strictly BEFORE the current bigram (which always matches itself)
+    m = m & (idx[None, :] + 1 < hist_len[:, None] - 1)
+    has = m.any(axis=1)
+    j = jnp.max(jnp.where(m, idx[None, :], -1), axis=1)   # latest match
+    dpos = j[:, None] + 2 + jnp.arange(gamma)[None, :]
+    d = jnp.take_along_axis(hist, jnp.clip(dpos, 0, length - 1), axis=1)
+    ok = has[:, None] & (dpos < hist_len[:, None])
+    return jnp.where(ok, d, tok[:, None]).astype(jnp.int32)
+
+
+@functools.lru_cache(maxsize=32)
+def _spec_decode_program(cfg_tuple, num_slots: int, chunk: int,
+                         gamma: int):
+    """Self-drafting speculative decoding (arXiv 2302.01318), fused into
+    the ``decode_chunk`` scan: each scanned iteration drafts ``gamma``
+    tokens per slot by n-gram lookup over the slot's own token history,
+    scores ``[tok, d_1..d_γ]`` in ONE batched ``γ+1``-token model call,
+    then runs the vectorized accept/reject entirely on device.
+
+    EXACTNESS (stronger than the usual greedy-only guarantee): position
+    ``i``'s token is sampled from the true conditional
+    ``p(· | prefix, accepted_{<i})`` with the request's own key
+    ``fold_in(base, gen_idx+i)`` — the draft only decides how many of
+    those samples one dispatch may keep (the leading run where
+    ``sampled_i == draft_i``, plus one bonus token at the first
+    mismatch). The emitted stream is therefore IDENTICAL to the
+    non-speculative engine for EVERY sampling configuration, not just
+    greedy. Rejected drafts need no page copy: the rollback is a cursor
+    rewind — their K/V sit beyond the new cursor in slot-owned blocks,
+    causally masked until overwritten (exactly how padded prefill K/V
+    are retired)."""
+    cfg = GPTConfig(*cfg_tuple)
+    model = GPT(cfg)
+    g1 = int(gamma) + 1
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def spec(params, cache, bt, hist, tok, active, pos, base_keys,
+             gen_idx, remaining, eos, temp, top_k, top_p):
+        sample_row = jax.vmap(sample_logits,
+                              in_axes=(0, 0, None, None, None))
+
+        def body(carry, _):
+            cache, tok, act, pos, gidx, rem, hist, nanc, _lg = carry
+            hist_len = pos + 1                # prompt + emitted count
+            drafts = _ngram_draft(hist, hist_len, tok, gamma)   # [S, γ]
+            inp = jnp.concatenate([tok[:, None], drafts], axis=1)
+            bt_eff = jnp.where(act[:, None], bt, 0)
+            logits, varsc = model.apply(
+                {"params": params, "cache": cache}, inp, train=False,
+                mutable=["cache"], block_table=bt_eff, cache_pos=pos)
+            # latched per-iteration quarantine (see the paged decode
+            # program) — position 0 only: later positions may be
+            # LEGALLY NaN from the per-position window-overflow poison
+            # on rejected drafts, while position 0 is always in-window
+            # for an active row
+            nanc = nanc | (act & ~jnp.isfinite(logits[:, 0]).all(axis=-1))
+            idxs = gidx[:, None] + jnp.arange(g1)[None, :]
+            keys = jax.vmap(jax.vmap(jax.random.fold_in,
+                                     in_axes=(None, 0)))(base_keys, idxs)
+            sampled = jax.vmap(sample_row)(logits, keys, temp, top_k,
+                                           top_p)              # [S, γ+1]
+            match = (sampled[:, :gamma] == drafts).astype(jnp.int32)
+            acc = jnp.cumprod(match, axis=1).sum(axis=1)        # [S]
+            m = acc + 1                       # leading matches + bonus
+            pidx = jnp.arange(g1)[None, :]
+            is_eos = (eos[:, None] >= 0) & (sampled == eos[:, None])
+            eos_hit = is_eos & (pidx < m[:, None])
+            any_eos = eos_hit.any(axis=1)
+            m = jnp.where(any_eos, jnp.argmax(eos_hit, axis=1) + 1, m)
+            m = jnp.minimum(m, rem)           # max-tokens cap
+            m = jnp.where(act, m, 0)
+            emit = (pidx < m[:, None]) & act[:, None]           # [S, γ+1]
+            new_tok = jnp.take_along_axis(
+                sampled, jnp.maximum(m - 1, 0)[:, None], axis=1)[:, 0]
+            new_tok = jnp.where(act, new_tok, tok).astype(jnp.int32)
+            rem = rem - m
+            done = act & ((rem <= 0) | any_eos)
+            # history grows by the emitted tokens so the NEXT iteration's
+            # draft can match against them
+            rows = jnp.arange(num_slots)[:, None]
+            hpos = jnp.clip(hist_len[:, None] + pidx, 0,
+                            cfg.block_size - 1)
+            hist = hist.at[rows, hpos].set(
+                jnp.where(emit, sampled, hist[rows, hpos]))
+            lg = logits[:, 0]                 # teacher-forcing observable
+            return ((varsc["cache"], new_tok, act & ~done, pos + m,
+                     gidx + m, rem, hist, nanc, lg), (sampled, emit))
+
+        lg0 = jnp.zeros((num_slots, cfg.vocab_size), jnp.float32)
+        nan0 = jnp.zeros((num_slots,), bool)
+        (cache, tok, active, pos, gen_idx, remaining, hist, nan_seen,
+         lg), (toks, emit) = jax.lax.scan(
+                body, (cache, tok, active, pos, gen_idx, remaining,
+                       hist, nan0, lg0), None, length=chunk)
+        return toks, emit, lg, tok, active, pos, nan_seen, cache
+
+    return spec
+
+
 class InferenceEngine:
     """Slot-level mechanics: caches, prefill, the shared decode step.
 
@@ -223,31 +616,99 @@ class InferenceEngine:
     """
 
     def __init__(self, params: PyTree, config: GPTConfig,
-                 num_slots: int = 8, decode_chunk: int = 1):
+                 num_slots: int = 8, decode_chunk: int = 1,
+                 paged: bool = False, page_size: int = 16,
+                 kv_pages: Optional[int] = None, spec_tokens: int = 0):
         """``decode_chunk``: decode steps fused into one dispatch (a
         device-side scan with on-device EOS/max-token bookkeeping).
         1 = purest continuous batching — admission/eviction can happen
         after every token. Larger chunks amortize per-dispatch overhead
         (the lever that beats ``generate_fast``'s whole-request scan on
         throughput) at the cost of slot-turnaround latency: a slot
-        finishing mid-chunk frees only at the chunk boundary."""
+        finishing mid-chunk frees only at the chunk boundary.
+
+        ``paged=True`` switches the KV cache to a page POOL
+        (``kv_pages`` pages of ``page_size`` tokens; default pool =
+        1 null page + ``num_slots`` full windows) with a per-slot block
+        table, a ref-counted allocator and a prefix hash table: a prompt
+        whose longest block-aligned prefix is already resident is
+        admitted WITHOUT re-prefilling or copying those blocks.
+        ``spec_tokens=γ > 0`` (paged only) adds self-drafting
+        speculative decoding: each decode iteration drafts γ tokens by
+        n-gram lookup and verifies them in one batched model call —
+        token streams stay EXACTLY equal to the non-speculative engine
+        (see ``_spec_decode_program``)."""
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         if decode_chunk < 1:
             raise ValueError(
                 f"decode_chunk must be >= 1, got {decode_chunk}")
-        self.config = decode_config(config)
+        if spec_tokens < 0:
+            raise ValueError(
+                f"spec_tokens must be >= 0, got {spec_tokens}")
+        if spec_tokens and not paged:
+            raise ValueError(
+                "speculative decoding rides on the paged KV path — pass "
+                "paged=True (the rollback contract needs slot-owned "
+                "write blocks)")
+        self.paged = bool(paged)
+        self.spec_tokens = int(spec_tokens)
+        base_cfg = decode_config(config)
         self.block_size = int(config.block_size)
         self.num_slots = int(num_slots)
         self.decode_chunk = int(decode_chunk)
+        if self.paged:
+            if page_size < 1 or self.block_size % page_size:
+                raise ValueError(
+                    f"page_size must be >= 1 and divide block_size "
+                    f"{self.block_size}, got {page_size}")
+            self.page_size = int(page_size)
+            self.max_blocks = self.block_size // self.page_size
+            if kv_pages is None:
+                # null page + one full window per slot + one page of
+                # copy-on-write headroom (also satisfies the 1-slot
+                # minimum below)
+                kv_pages = 2 + self.num_slots * self.max_blocks
+            if kv_pages < 2 + self.max_blocks:
+                raise ValueError(
+                    f"kv_pages={kv_pages} too small: need the null page "
+                    f"+ one full window ({self.max_blocks} blocks) + one "
+                    f"copy-on-write page")
+            self.kv_pages = int(kv_pages)
+            self.config = dataclasses.replace(
+                base_cfg, page_size=self.page_size, kv_pages=self.kv_pages)
+            self._alloc = BlockAllocator(self.kv_pages, self.page_size)
+        else:
+            self.page_size = 0
+            self.max_blocks = 0
+            self.kv_pages = 0
+            self.config = base_cfg
+            self._alloc = None
         self.params = jax.tree.map(jnp.asarray, params)
         self._cfg_tuple = dataclasses.astuple(self.config)
-        self._admit_prog, self._decode_prog = _slot_programs(
-            self._cfg_tuple, self.num_slots, self.decode_chunk)
+        if self.paged:
+            self._admit_prog = None
+            self._decode_prog = _paged_decode_program(
+                self._cfg_tuple, self.num_slots, self.decode_chunk)
+            self._cow_prog = _cow_program(self._cfg_tuple)
+            self._spec_prog = (
+                _spec_decode_program(self._cfg_tuple, self.num_slots,
+                                     self.decode_chunk, self.spec_tokens)
+                if self.spec_tokens else None)
+        else:
+            self._admit_prog, self._decode_prog = _slot_programs(
+                self._cfg_tuple, self.num_slots, self.decode_chunk)
+            self._cow_prog = None
+            self._spec_prog = None
         self._step1_prog = None          # lazy chunk-1 twin (teacher forcing)
         self._seen_buckets: set = set()
         self._cache = self._init_cache()
         s = self.num_slots
+        if self.paged:
+            self._bt = np.zeros((s, self.max_blocks), np.int32)
+            self._pos = np.zeros(s, np.int32)          # per-slot KV cursor
+            self._hist = np.zeros((s, self.block_size), np.int32)
+            self._prompt_len = np.zeros(s, np.int32)
         self._active = np.zeros(s, bool)
         self._next_tok = np.zeros(s, np.int32)     # input token per slot
         self._gen_idx = np.zeros(s, np.int32)      # key-schedule index
@@ -264,9 +725,21 @@ class InferenceEngine:
     def _init_cache(self) -> PyTree:
         model = GPT(self.config)
         dummy = jnp.zeros((self.num_slots, 1), jnp.int32)
-        shapes = jax.eval_shape(
-            lambda: model.init({"params": jax.random.PRNGKey(0)}, dummy,
-                               train=False))
+        if self.paged:
+            # the pool is batch-shape independent ([kv_pages, page, H,
+            # hd] per layer): a 1-row prefill and an S-row decode run
+            # against the SAME buffers — that is what makes the prefix
+            # blocks shareable without an admit-scatter program
+            shapes = jax.eval_shape(
+                lambda: model.init(
+                    {"params": jax.random.PRNGKey(0)}, dummy, train=False,
+                    block_table=jnp.zeros(
+                        (self.num_slots, self.max_blocks), jnp.int32),
+                    cache_pos=jnp.zeros((self.num_slots,), jnp.int32)))
+        else:
+            shapes = jax.eval_shape(
+                lambda: model.init({"params": jax.random.PRNGKey(0)},
+                                   dummy, train=False))
         return jax.tree.map(lambda sh: jnp.zeros(sh.shape, sh.dtype),
                             shapes["cache"])
 
@@ -305,6 +778,114 @@ class InferenceEngine:
                 f"KV cache (block_size {self.block_size}); crop the prompt "
                 f"to block_size - max_new_tokens, or use `generate`, whose "
                 f"full-context resampling slides the context window")
+        if self.paged:
+            # worst case (zero prefix hits, +1 copy-on-write headroom)
+            # must fit the pool EVER, so a queued request always
+            # eventually admits once running slots release their blocks
+            worst = -(-(n + sp.max_new_tokens) // self.page_size) + 1
+            if worst > self.kv_pages - 1:
+                raise ValueError(
+                    f"request needs up to {worst} KV blocks but the "
+                    f"paged pool holds {self.kv_pages - 1}; raise "
+                    f"kv_pages or shrink prompt/max_new_tokens")
+
+    # -- paged planning ---------------------------------------------------
+
+    def _walk_prefix(self, prompt: np.ndarray):
+        """Consecutive resident full prompt blocks: ``(hit_pages,
+        chain_cids)`` — THE prefix probe, shared by planning, capacity
+        checks and the scheduler's ordering score (no LRU touch; only a
+        committing admission refreshes recency)."""
+        page, al = self.page_size, self._alloc
+        hit_pages: List[int] = []
+        chain: List[int] = []
+        cid = 0
+        for b in range(len(prompt) // page):
+            ent = al.probe(cid, prompt[b * page:(b + 1) * page].tobytes())
+            if ent is None:
+                break
+            hit_pages.append(ent[0])
+            cid = ent[1]
+            chain.append(cid)
+        return hit_pages, chain
+
+    def _plan_paged(self, prompt: np.ndarray, max_new: int):
+        """Plan a paged admission without mutating allocator state:
+        returns ``(hit_pages, chain_cids, cow_src, parent_cid, start,
+        suffix, bucket, n_new, need)``. ``hit_pages`` are the resident
+        shared-prefix blocks (to be pinned), ``cow_src`` a fully-matched
+        final block to copy-on-write (its last token is re-forwarded for
+        the first-token logits — recomputing INTO the shared page would
+        perturb other readers by the recompute's rounding), ``n_new``
+        the fresh blocks to allocate and ``need`` the total pages the
+        admission must obtain (n_new + the CoW page)."""
+        n = len(prompt)
+        page, s_max = self.page_size, self.block_size
+        full = n // page
+        hit_pages, chain = self._walk_prefix(prompt)
+        cid = chain[-1] if chain else 0
+        cow_src = None
+        if hit_pages and len(hit_pages) * page == n:
+            cow_src = hit_pages.pop()
+            chain.pop()
+            cid = chain[-1] if chain else 0
+        matched = len(hit_pages) * page
+        suffix = n - matched
+        # pad writes (suffix rounded up to its bucket) must stay inside
+        # the [block_size] window: un-share blocks until they do. Rare —
+        # only near-full-window prompts with a large unshared suffix.
+        # The CoW path is exempt: its real suffix is ONE token (bucket
+        # 1, start n-1 ≤ block_size-1 always fits) — running the guard
+        # on the stale pre-override suffix could otherwise pop hits
+        # whose table slots the CoW branch does not re-point.
+        while cow_src is None and hit_pages \
+                and matched + prompt_bucket(suffix, s_max) > s_max:
+            hit_pages.pop()
+            chain.pop()
+            cid = chain[-1] if chain else 0
+            matched -= page
+            suffix += page
+        if cow_src is not None:
+            start, suffix, bucket = n - 1, 1, 1
+            first_new = full                 # CoW page covers block full-1
+        else:
+            start = matched
+            bucket = prompt_bucket(suffix, s_max)
+            first_new = matched // page
+        end_tokens = max(n + max_new, start + bucket)
+        n_new = -(-end_tokens // page) - first_new
+        need = n_new + (1 if cow_src is not None else 0)
+        return (hit_pages, chain, cow_src, cid, start, suffix, bucket,
+                n_new, need)
+
+    def admit_probe(self, prompt, sp: SamplingParams) -> Tuple[bool, int]:
+        """ONE planning walk answering both scheduler questions:
+        ``(would admit() succeed right now, resident-prefix score)``.
+        The capacity answer is exact, not conservative — it runs the
+        same plan ``admit`` would and excludes the would-be-pinned
+        prefix blocks from the evictable supply. Unpaged:
+        ``(True, 0)`` — ordering degrades to FCFS."""
+        if not self.paged:
+            return True, 0
+        p = np.asarray(prompt, np.int32).reshape(-1)
+        hit_pages, _chain, cow_src, _cid, _start, _suffix, _bucket, \
+            _n_new, need = self._plan_paged(p, sp.max_new_tokens)
+        pinned = hit_pages + ([cow_src] if cow_src is not None else [])
+        score = len(hit_pages) + (1 if cow_src is not None else 0)
+        return self._alloc.available(exclude=pinned) >= need, score
+
+    def resident_prefix_blocks(self, prompt) -> int:
+        """How many leading full blocks of ``prompt`` the prefix cache
+        could serve right now. 0 on an unpaged engine."""
+        if not self.paged:
+            return 0
+        p = np.asarray(prompt, np.int32).reshape(-1)
+        return len(self._walk_prefix(p)[0])
+
+    def has_capacity(self, prompt, sp: SamplingParams) -> bool:
+        """Whether an ``admit`` of this request would succeed RIGHT NOW
+        (block supply; the caller checks ``free_slots`` itself)."""
+        return self.admit_probe(prompt, sp)[0]
 
     def admit(self, prompt: np.ndarray,
               sp: SamplingParams) -> Tuple[int, TokenEvent]:
@@ -322,28 +903,34 @@ class InferenceEngine:
         slot = free[0]
         fault_point("serve.prefill")
         n = len(prompt)
-        bucket = prompt_bucket(n, self.block_size)
-        self._seen_buckets.add(bucket)
-        # count true program-cache misses: the compile-bound observable is
-        # XLA compilations, and a program another engine over the same
-        # config already compiled is a hit, not a compile
-        before = _prefill_program.cache_info().misses
-        prefill = _prefill_program(self._cfg_tuple, bucket)
-        if _prefill_program.cache_info().misses > before:
-            self.stats.prefill_compiles += 1
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :n] = prompt
         base_key = np.asarray(jax.random.PRNGKey(sp.seed), np.uint32)
         top_k = (self.config.vocab_size if sp.top_k is None
                  else int(sp.top_k))
         top_p = 1.0 if sp.top_p is None else float(sp.top_p)
-        tok, row_cache = prefill(
-            self.params, jnp.asarray(padded), np.int32(n),
-            jnp.asarray(base_key), np.float32(sp.temperature),
-            np.int32(top_k), np.float32(top_p))
-        self._cache = self._admit_prog(self._cache, row_cache,
-                                       np.int32(slot), np.int32(n))
-        first = int(np.asarray(tok)[0])
+        if self.paged:
+            first = self._prefill_paged(slot, prompt, sp, base_key,
+                                        top_k, top_p)
+        else:
+            bucket = prompt_bucket(n, self.block_size)
+            self._seen_buckets.add(bucket)
+            # count true program-cache misses: the compile-bound
+            # observable is XLA compilations, and a program another
+            # engine over the same config already compiled is a hit,
+            # not a compile
+            before = _prefill_program.cache_info().misses
+            prefill = _prefill_program(self._cfg_tuple, bucket)
+            if _prefill_program.cache_info().misses > before:
+                self.stats.prefill_compiles += 1
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :n] = prompt
+            tok, row_cache = prefill(
+                self.params, jnp.asarray(padded), np.int32(n),
+                jnp.asarray(base_key), np.float32(sp.temperature),
+                np.int32(top_k), np.float32(top_p))
+            self._cache = self._admit_prog(self._cache, row_cache,
+                                           np.int32(slot), np.int32(n))
+            first = int(np.asarray(tok)[0])
+            self.stats.prefill_tokens += bucket
         self.stats.prefills += 1
         self.stats.tokens_generated += 1
         # slot bookkeeping: the first token came from the prefill (key
@@ -358,19 +945,137 @@ class InferenceEngine:
         self._top_k[slot] = top_k
         self._top_p[slot] = top_p
         self._base_keys[slot] = base_key
+        if self.paged:
+            # token history feeds the n-gram draft; the first token is
+            # emitted (index n), giving hist_len == cursor + 1
+            self._hist[slot, n] = first
         finished = (sp.max_new_tokens <= 1
                     or (sp.eos_token is not None and first == sp.eos_token))
         if finished:
             self._active[slot] = False
+            if self.paged:
+                self._release_pages(slot)
         self.stats.active_slots = int(self._active.sum())
         self.stats.prefill_buckets = tuple(sorted(self._seen_buckets))
         return slot, TokenEvent(slot, first, finished)
 
+    def _prefill_paged(self, slot: int, prompt: np.ndarray,
+                       sp: SamplingParams, base_key, top_k: int,
+                       top_p: float) -> int:
+        """Prefix-aware paged prefill: pin the resident shared-prefix
+        blocks, copy-on-write a fully-matched final block, allocate the
+        owned blocks (prefill pads + the whole decode budget — blocks
+        are reserved at admit, so mid-decode writes can never need an
+        allocation the jitted program couldn't perform), dispatch the
+        SUFFIX-only prefill, then content-register this prompt's own
+        full blocks for future requests to hit."""
+        n = len(prompt)
+        page, al = self.page_size, self._alloc
+        full = n // page
+        hit_pages, chain, cow_src, cid, start, suffix, bucket, n_new, \
+            need = self._plan_paged(prompt, sp.max_new_tokens)
+        # `held` tracks every page reference this admission currently
+        # owns; ANY failure past this point (capacity shortfall, a
+        # compile/dispatch error in CoW or prefill) unwinds it exactly —
+        # an admission that fails its request must not shrink the pool
+        held: List[int] = []
+        try:
+            # pin before the capacity check: a pinned page is neither
+            # evictable nor double-counted as supply
+            for pg in hit_pages:
+                al.incref(pg)
+                held.append(pg)
+            if cow_src is not None:
+                al.incref(cow_src)
+                held.append(cow_src)
+            if al.available() < need:
+                raise NoFreeBlocksError(
+                    f"paged KV pool cannot supply {need} blocks right "
+                    f"now — retry after running requests release")
+            row = np.zeros(self.max_blocks, np.int32)
+            row[:len(hit_pages)] = hit_pages
+            next_b = len(hit_pages)
+            if cow_src is not None:
+                dst = al.alloc()
+                held.append(dst)
+                row[next_b] = dst
+                next_b += 1
+                self._cache = self._cow_prog(
+                    self._cache, np.int32(cow_src), np.int32(dst))
+                al.decref(cow_src)       # pinned only for the copy
+                held.remove(cow_src)
+            for k in range(n_new):
+                pg = al.alloc()
+                held.append(pg)
+                row[next_b + k] = pg
+            self._bt[slot] = 0
+            self._bt[slot, :next_b + n_new] = row[:next_b + n_new]
+            self._seen_buckets.add(bucket)
+            before = _paged_prefill_program.cache_info().misses
+            prefill = _paged_prefill_program(self._cfg_tuple, bucket)
+            if _paged_prefill_program.cache_info().misses > before:
+                self.stats.prefill_compiles += 1
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :suffix] = prompt[start:]
+            tok, self._cache = prefill(
+                self.params, self._cache,
+                jnp.asarray(self._bt[slot][None]),
+                jnp.asarray(np.asarray([start], np.int32)),
+                jnp.asarray(padded), np.int32(suffix),
+                jnp.asarray(base_key), np.float32(sp.temperature),
+                np.int32(top_k), np.float32(top_p))
+        except BaseException:
+            for pg in held:
+                al.decref(pg)
+            self._bt[slot] = 0
+            raise
+        # only a COMMITTING admission refreshes hit recency — planning
+        # probes must not keep a never-admitted prefix artificially hot
+        for pg in hit_pages:
+            al.touch(pg)
+        if cow_src is None:
+            # register the freshly-prefilled full PROMPT blocks (their
+            # content is immutable — decode writes start past them);
+            # the CoW path has nothing new: every block was cached
+            reg_cid = cid
+            for b in range(len(hit_pages), full):
+                reg_cid = al.register(
+                    reg_cid, prompt[b * page:(b + 1) * page].tobytes(),
+                    int(row[b]))
+        self._pos[slot] = n
+        self._hist[slot] = 0
+        self._hist[slot, :n] = prompt
+        self._prompt_len[slot] = n
+        self.stats.prefix_hit_blocks += (len(hit_pages)
+                                         + (1 if cow_src is not None
+                                            else 0))
+        self.stats.prefill_tokens += bucket
+        self.stats.kv_blocks_in_use = al.in_use()
+        self.stats.kv_blocks_cached = al.cached()
+        return int(np.asarray(tok)[0])
+
+    def _release_pages(self, slot: int) -> None:
+        """Drop this slot's block-table references (idempotent: an
+        already-cleared row is a no-op). Cached prefix blocks stay
+        resident at refcount 0; plain owned blocks return to the free
+        list."""
+        if not self.paged:
+            return
+        for pg in self._bt[slot]:
+            if pg:
+                self._alloc.decref(int(pg))
+        self._bt[slot] = 0
+        self.stats.kv_blocks_in_use = self._alloc.in_use()
+        self.stats.kv_blocks_cached = self._alloc.cached()
+
     def release(self, slot: int) -> None:
         """Free a slot between decode steps (EOS/max-tokens eviction or a
-        cancelled request). The cache rows stay as-is — the next admit
-        overwrites them wholesale."""
+        cancelled request). Unpaged, the cache rows stay as-is — the next
+        admit overwrites them wholesale; paged, the slot's block-table
+        references are dropped (shared prefix blocks stay resident for
+        future hits)."""
         self._active[slot] = False
+        self._release_pages(slot)
         self.stats.active_slots = int(self._active.sum())
 
     def step(self, override_tokens: Optional[Dict[int, int]] = None
@@ -389,14 +1094,22 @@ class InferenceEngine:
         the forced history, while sampling proceeds normally.
         """
         prog = self._decode_prog
+        spec_run = self._spec_prog is not None
         if override_tokens:
             for slot, tok in override_tokens.items():
                 self._next_tok[slot] = int(tok)
-            if self.decode_chunk != 1:
+            spec_run = False
+            if self.decode_chunk != 1 or self._spec_prog is not None:
                 if self._step1_prog is None:
-                    _, self._step1_prog = _slot_programs(
-                        self._cfg_tuple, self.num_slots, 1)
+                    if self.paged:
+                        self._step1_prog = _paged_decode_program(
+                            self._cfg_tuple, self.num_slots, 1)
+                    else:
+                        _, self._step1_prog = _slot_programs(
+                            self._cfg_tuple, self.num_slots, 1)
                 prog = self._step1_prog
+        elif spec_run:
+            prog = self._spec_prog
         if not self._active.any():
             return []
         # hit-counted AFTER the idle early-out so hit N is the Nth REAL
@@ -404,48 +1117,95 @@ class InferenceEngine:
         fault_point("serve.decode")
         was_active = self._active.copy()
         remaining = (self._max_new - self._generated).astype(np.int32)
-        toks, emitted, lg, final_tok, final_active, cache = prog(
-            self.params, self._cache, jnp.asarray(self._next_tok),
-            jnp.asarray(self._active), jnp.asarray(self._base_keys),
-            jnp.asarray(self._gen_idx), jnp.asarray(remaining),
-            jnp.asarray(self._eos.astype(np.int32)),
-            jnp.asarray(self._temp), jnp.asarray(self._top_k),
-            jnp.asarray(self._top_p))
+        tail = (jnp.asarray(self._next_tok), jnp.asarray(self._active),
+                jnp.asarray(self._base_keys), jnp.asarray(self._gen_idx),
+                jnp.asarray(remaining),
+                jnp.asarray(self._eos.astype(np.int32)),
+                jnp.asarray(self._temp), jnp.asarray(self._top_k),
+                jnp.asarray(self._top_p))
+        if self.paged:
+            head = (self.params, self._cache, jnp.asarray(self._bt))
+            if spec_run:
+                head += (jnp.asarray(self._hist),)
+            tok_a, act_a, keys_a, gidx_a, rem_a, eos_a, t_a, k_a, p_a = \
+                tail
+            toks, emitted, lg, final_tok, final_active, final_pos, \
+                nan_seen, cache = prog(*head, tok_a, act_a,
+                                       jnp.asarray(self._pos), keys_a,
+                                       gidx_a, rem_a, eos_a, t_a, k_a,
+                                       p_a)
+            self._pos = np.asarray(final_pos).astype(np.int32).copy()
+            nan_seen = np.asarray(nan_seen)
+        else:
+            toks, emitted, lg, final_tok, final_active, cache = prog(
+                self.params, self._cache, *tail)
+            nan_seen = None
         self._cache = cache
-        toks = np.asarray(toks)                    # [chunk, S]
-        emitted = np.asarray(emitted)              # [chunk, S] bool
+        toks = np.asarray(toks)
+        emitted = np.asarray(emitted)
+        if toks.ndim == 2:
+            # non-speculative programs emit one token per scanned step;
+            # widen to the speculative [chunk, S, γ+1] layout so ONE host
+            # replay path routes both
+            toks = toks[..., None]
+            emitted = emitted[..., None]
         self.last_logits = np.asarray(lg)
         self._next_tok = np.asarray(final_tok).astype(np.int32).copy()
         self._active = np.asarray(final_active).copy()
         # numerical quarantine: non-finite logits fail ONLY their own
         # slot — the model's per-row cache math keeps rows isolated (and
-        # _decode_attend NaN-poisons an overflowing row on purpose, so
-        # this is the designated catch point). The check reads the LAST
-        # scanned step's logits for every slot that emitted ANYWHERE in
-        # this chunk: a poisoned slot that hits max-tokens mid-chunk
-        # goes inactive, but its final-step logits still flow from the
-        # NaN K/V in its cache rows, so the poison stays visible (NaN
-        # never compares equal to EOS, so EOS can't self-evict it
-        # either). Slots inactive for the whole chunk are excluded —
-        # their garbage compute quarantines no one.
-        bad = emitted.any(axis=0) & ~np.isfinite(self.last_logits).all(
-            axis=1)
+        # the decode attends NaN-poison an overflowing row/position on
+        # purpose, so this is the designated catch point). Unpaged, the
+        # check reads the LAST scanned step's logits for every slot
+        # that emitted anywhere in this chunk: a poisoned slot that
+        # finishes mid-chunk goes inactive but keeps attending its own
+        # NaN cache rows, so the poison stays visible in the final
+        # logits. Paged, that witness FAILS — a finished row's table is
+        # redirected to the null page, so its later iterations read
+        # clean garbage — and the programs instead LATCH non-finite
+        # logits per iteration while the row is active (`nan_seen`).
+        if nan_seen is not None:
+            bad = nan_seen
+        else:
+            bad = emitted.any(axis=(0, 2)) & ~np.isfinite(
+                self.last_logits).all(axis=1)
         for slot in np.nonzero(bad)[0]:
             self._active[slot] = False           # quarantine = evict
             self.stats.quarantined += 1
         events: List[TokenEvent] = []
         n_steps = toks.shape[0]
         for k in range(n_steps):
-            for slot in np.nonzero(emitted[k])[0]:
-                tok = int(toks[k, slot])
-                self._gen_idx[slot] += 1
-                self._generated[slot] += 1
-                # finished iff the device stopped emitting for this slot
-                # (its last emitted step) and it came back inactive
-                last_emit = not emitted[k + 1:, slot].any()
-                finished = bool(last_emit and not self._active[slot])
-                events.append(TokenEvent(int(slot), tok, finished,
-                                         poisoned=bool(bad[slot])))
+            for slot in np.nonzero(emitted[k].any(axis=1))[0]:
+                if spec_run:
+                    # acceptance accounting: γ drafted per active slot
+                    # per iteration; all emitted beyond the one
+                    # guaranteed token were accepted drafts
+                    self.stats.spec_drafted += self.spec_tokens
+                    self.stats.spec_accepted += int(
+                        emitted[k, slot].sum()) - 1
+                for j in np.nonzero(emitted[k, slot])[0]:
+                    tok = int(toks[k, slot, j])
+                    if self.paged:
+                        hl = (int(self._prompt_len[slot])
+                              + int(self._generated[slot]))
+                        if hl < self.block_size:
+                            self._hist[slot, hl] = tok
+                    self._gen_idx[slot] += 1
+                    self._generated[slot] += 1
+                    # finished iff the device stopped emitting for this
+                    # slot (its last emitted token) and it came back
+                    # inactive
+                    last_emit = (not emitted[k, slot, j + 1:].any()
+                                 and not emitted[k + 1:, slot].any())
+                    finished = bool(last_emit and not self._active[slot])
+                    events.append(TokenEvent(int(slot), tok, finished,
+                                             poisoned=bool(bad[slot])))
+        if self.paged:
+            # blocks of slots that finished (or were quarantined) this
+            # chunk go back to the allocator; shared prefix blocks stay
+            # resident for future hits
+            for slot in np.nonzero(was_active & ~self._active)[0]:
+                self._release_pages(slot)
         self.stats.tokens_generated += len(events)
         self.stats.decode_steps += int(was_active.any()) * n_steps
         self.stats.active_slots = int(self._active.sum())

@@ -12,8 +12,11 @@ kinds share the header:
   control turned it away before it was ever enqueued).
 - ``engine``  — a periodic engine sample (every ``engine_log_every``
   ticks of the driver loop): cumulative tokens, rolling tokens/s, queue
-  depth, active-slot occupancy. ``status=restart`` marks a supervisor
-  engine rebuild.
+  depth, active-slot occupancy, plus the paged-KV/speculative
+  observables ``kv_blocks_in_use`` / ``prefix_hit_blocks`` /
+  ``spec_accept_rate`` (blank-or-zero on unpaged engines and absent in
+  pre-paging CSVs). ``status=restart`` marks a supervisor engine
+  rebuild.
 
 Beyond the counters, the collector maintains a tokens/s EWMA over driver
 ticks — the live service-rate estimate ``Scheduler.submit`` uses for
@@ -43,6 +46,9 @@ HEADER = [
     "ts_s", "kind", "request_id", "status", "queue_depth", "active_slots",
     "prompt_tokens", "new_tokens", "ttft_s", "avg_token_latency_s",
     "cum_tokens", "tokens_per_s",
+    # paged-KV / speculative observables (engine rows; blank on request
+    # rows and absent in pre-paging CSVs — read_headline tolerates both)
+    "kv_blocks_in_use", "prefix_hit_blocks", "spec_accept_rate",
 ]
 
 #: EWMA smoothing for the live tokens/s estimate (per driver tick with
@@ -117,6 +123,11 @@ class ServeMetrics:
         self._ewma_last_t: Optional[float] = None
         self._ewma_idle_reset_s = float(ewma_idle_reset_s)
         self._idle_since: Optional[float] = None
+        # last engine sample of the paged/speculative observables (an
+        # unpaged engine reports 0 blocks and a None accept rate)
+        self._kv_blocks_in_use = 0
+        self._prefix_hit_blocks = 0
+        self._spec_accept_rate: Optional[float] = None
 
     def _now(self) -> float:
         return time.perf_counter() - self._t0
@@ -153,6 +164,7 @@ class ServeMetrics:
                 "" if ttft is None else f"{ttft:.5f}",
                 "" if lat is None else f"{lat:.5f}",
                 self.tokens_out, f"{self.tokens_per_s():.2f}",
+                "", "", "",
             ])
             self._f.flush()
 
@@ -168,6 +180,7 @@ class ServeMetrics:
                 f"{self._now():.4f}", "request", "", "rejected",
                 queue_depth, active_slots, "", "", "", "",
                 self.tokens_out, f"{self.tokens_per_s():.2f}",
+                "", "", "",
             ])
             self._f.flush()
 
@@ -180,7 +193,7 @@ class ServeMetrics:
             self._w.writerow([
                 f"{self._now():.4f}", "engine", "", "restart", "", "",
                 "", "", "", "", self.tokens_out,
-                f"{self.tokens_per_s():.2f}",
+                f"{self.tokens_per_s():.2f}", "", "", "",
             ])
             self._f.flush()
 
@@ -221,6 +234,13 @@ class ServeMetrics:
                 else:
                     self._idle_since = None
             self._ewma_last_tok, self._ewma_last_t = tok, now
+            self._kv_blocks_in_use = int(
+                getattr(stats, "kv_blocks_in_use", 0))
+            self._prefix_hit_blocks = int(
+                getattr(stats, "prefix_hit_blocks", 0))
+            rate_fn = getattr(stats, "spec_accept_rate", None)
+            self._spec_accept_rate = rate_fn() if callable(rate_fn) \
+                else None
             self._ticks += 1
             if self._ticks % self._every:
                 return
@@ -228,6 +248,9 @@ class ServeMetrics:
                 f"{now:.4f}", "engine", "", "", queue_depth,
                 stats.active_slots, "", "", "", "",
                 stats.tokens_generated, f"{self.tokens_per_s():.2f}",
+                self._kv_blocks_in_use, self._prefix_hit_blocks,
+                ("" if self._spec_accept_rate is None
+                 else f"{self._spec_accept_rate:.4f}"),
             ])
 
     def tokens_per_s(self) -> float:
@@ -259,6 +282,11 @@ class ServeMetrics:
                 "mean_token_latency_s": (
                     round(self._lat_sum / self._lat_n, 5)
                     if self._lat_n else None),
+                "kv_blocks_in_use": self._kv_blocks_in_use,
+                "prefix_hit_blocks": self._prefix_hit_blocks,
+                "spec_accept_rate": (
+                    round(self._spec_accept_rate, 4)
+                    if self._spec_accept_rate is not None else None),
             }
             head.update(_percentiles(self._ttfts, "ttft"))
             head.update(_percentiles(self._lats, "token_lat"))
@@ -305,11 +333,20 @@ def read_headline(path: str) -> Dict[str, Any]:
     last_ts = 0.0
     ttfts: List[float] = []
     lats: List[float] = []
+    kv_blocks, prefix_hits, spec_rate = 0, 0, None
     with open(path, newline="") as f:
         for row in csv.DictReader(f):
             last_ts = max(last_ts, float(row["ts_s"] or 0.0))
             if row["kind"] == "engine":
                 restarts += int(row["status"] == "restart")
+                # paged/spec observables: last engine sample wins (the
+                # columns are absent in pre-paging CSVs)
+                if row.get("kv_blocks_in_use"):
+                    kv_blocks = int(row["kv_blocks_in_use"])
+                if row.get("prefix_hit_blocks"):
+                    prefix_hits = int(row["prefix_hit_blocks"])
+                if row.get("spec_accept_rate"):
+                    spec_rate = float(row["spec_accept_rate"])
                 continue
             if row["kind"] != "request":
                 continue
@@ -336,6 +373,9 @@ def read_headline(path: str) -> Dict[str, Any]:
                         if ttfts else None),
         "mean_token_latency_s": (round(sum(lats) / len(lats), 5)
                                  if lats else None),
+        "kv_blocks_in_use": kv_blocks,
+        "prefix_hit_blocks": prefix_hits,
+        "spec_accept_rate": spec_rate,
     }
     head.update(_percentiles(ttfts, "ttft"))
     head.update(_percentiles(lats, "token_lat"))

@@ -13,9 +13,13 @@ The engine (``engine.py``) knows slots; this layer knows REQUESTS:
   being enqueued to time out: admission control / load shedding.
 - ``step``: one scheduling round, run by the single driver thread:
   shed queued requests past their deadline (before prefill), admit
-  queued requests into free slots (prefill), advance every active
-  slot one token (the shared decode step), and complete/evict finished
-  requests BETWEEN steps — continuous batching. Running requests past
+  queued requests into free slots (prefill; PREFIX-AWARE within a
+  bounded lookahead window — a request whose prompt prefix is resident
+  in the paged engine's prefix cache is admitted ahead of its FCFS turn
+  so shared-prefix bursts hit the cache before eviction churn loses
+  them), advance every active slot one token (the shared decode step),
+  and complete/evict finished requests BETWEEN steps — continuous
+  batching. Running requests past
   their deadline are cancelled at the chunk boundary and their slot
   freed; a slot the engine quarantined (NaN/Inf logits) fails only its
   own request.
@@ -47,7 +51,7 @@ from typing import Dict, List, Optional, Tuple, Union
 import numpy as np
 
 from ..utils.resilience import fault_point
-from .engine import InferenceEngine, SamplingParams
+from .engine import InferenceEngine, NoFreeBlocksError, SamplingParams
 
 
 class QueueFullError(RuntimeError):
@@ -166,10 +170,28 @@ class Scheduler:
     (or ``run``); any number of threads call ``submit``."""
 
     def __init__(self, engine: InferenceEngine, max_queue: int = 64,
-                 metrics=None):
+                 metrics=None, prefix_window: int = 8,
+                 starvation_rounds: int = 128):
+        """``prefix_window``: how many queued requests the admit step may
+        look ahead to prefer one whose prompt prefix is RESIDENT in the
+        paged engine's prefix cache (most resident blocks win, FCFS
+        breaks ties — so an unpaged engine, where every score is 0,
+        keeps exact FCFS order). 1 = strict FCFS.
+
+        ``starvation_rounds``: anti-starvation bound for the paged
+        block pool — once the HEAD request has been passed over this
+        many scheduling rounds for lack of blocks (while smaller
+        requests kept admitting and re-pinning them), admission stops
+        entirely until running slots drain and the head fits. Without
+        it a large-block-need request could wait unboundedly under a
+        sustained stream of small ones."""
         self.engine = engine
         self.max_queue = int(max_queue)
         self.metrics = metrics
+        self.prefix_window = max(1, int(prefix_window))
+        self.starvation_rounds = max(1, int(starvation_rounds))
+        self._head_skip_id: Optional[int] = None
+        self._head_skips = 0
         self._lock = threading.Lock()
         self._drained = threading.Condition(self._lock)
         self._queue: deque = deque()
@@ -297,6 +319,46 @@ class Scheduler:
                 self._drained.notify_all()
         return shed
 
+    def _pick_admit_index(self, engine: InferenceEngine) -> Optional[int]:
+        """Index of the next queued request to admit (caller holds the
+        lock). FCFS, except that within the first ``prefix_window``
+        queued requests the one with the most prompt-prefix blocks
+        RESIDENT in the paged engine's prefix cache wins (FCFS breaks
+        ties) — admit ordering is the cheapest way to turn shared-prefix
+        bursts into cache hits before eviction churn loses them.
+        Requests the block pool cannot serve right now are passed over
+        (running slots will free their blocks; ``engine.validate``
+        guarantees every queued request fits an idle pool) — bounded by
+        the starvation guard: once the HEAD request has been passed
+        over ``starvation_rounds`` times — whether for lack of blocks
+        OR because hotter-prefix requests kept outscoring it — it is
+        the only admissible choice: admit it, or (if the pool still
+        can't serve it) admit nothing until the pool drains. None =
+        admit nothing this round."""
+        head = self._queue[0]
+        if self._head_skip_id != head.id:
+            self._head_skip_id, self._head_skips = head.id, 0
+        starved = self._head_skips > self.starvation_rounds
+        best, best_score, head_ok = None, -1, False
+        for i, req in enumerate(
+                itertools.islice(self._queue, self.prefix_window)):
+            ok, score = engine.admit_probe(req.prompt, req.sampling)
+            if i == 0:
+                head_ok = ok
+                if starved:
+                    break        # the head's turn: it or nothing
+            if not ok:
+                continue
+            if score > best_score:
+                best, best_score = i, score
+        if starved:
+            best = 0 if head_ok else None
+        if best == 0:
+            self._head_skips = 0
+        else:
+            self._head_skips += 1
+        return best
+
     def _admit_from_queue(self, epoch: int,
                           engine: InferenceEngine) -> int:
         admitted = 0
@@ -304,7 +366,11 @@ class Scheduler:
             with self._drained:
                 if self._epoch != epoch or not self._queue:
                     break
-                req = self._queue.popleft()
+                idx = self._pick_admit_index(engine)
+                if idx is None:
+                    break          # block pool busy: admit next round
+                req = self._queue[idx]
+                del self._queue[idx]
                 if req.deadline_s is not None:
                     self._queued_deadlines -= 1
                 self._admitting = req
@@ -321,6 +387,26 @@ class Scheduler:
                 continue
             try:
                 slot, ev = engine.admit(req.prompt, req.sampling)
+            except NoFreeBlocksError:
+                # transient paged-pool shortage that appeared between the
+                # capacity probe and admit — reinsert at the ORIGINAL
+                # queue position (the request is fine; the blocks aren't
+                # there yet; jumping older requests would also perturb
+                # the starvation guard's head tracking). Positions ahead
+                # of idx only ever shrink via this driver thread, so the
+                # clamp preserves relative order. Skipped when a
+                # failover raced us: fail_inflight already owns the
+                # in-admission request's resolution.
+                with self._drained:
+                    mine = self._admitting is req
+                    if mine:
+                        self._admitting = None
+                    if mine and self._epoch == epoch:
+                        self._queue.insert(min(idx, len(self._queue)),
+                                           req)
+                        if req.deadline_s is not None:
+                            self._queued_deadlines += 1
+                break
             except Exception as e:  # noqa: BLE001 — a bad request must
                 # fail ITSELF, not tear the serving loop down
                 with self._lock:

@@ -40,7 +40,7 @@ assert set(sections) == {"lint", "trace", "audit"}
 for name, summ in sections["trace"]["strategies"].items():
     assert summ["ok"], (name, summ)
 assert len(sections["trace"]["strategies"]) >= 8
-assert len(sections["audit"]["programs"]) >= 12
+assert len(sections["audit"]["programs"]) >= 17
 print("ci_analyze: violations=0 across",
       len(sections["trace"]["strategies"]), "strategy configs and",
       len(sections["audit"]["programs"]), "programs")

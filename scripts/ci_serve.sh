@@ -1,12 +1,15 @@
 #!/usr/bin/env bash
-# Serving gate (ISSUE 4) — the serve/decode unit suites plus one CLI
-# smoke run through the real HTTP entry point, run NEXT TO
+# Serving gate (ISSUE 4 + ISSUE 7) — the serve/decode/paged unit suites
+# plus one CLI smoke run through the real HTTP entry point, run NEXT TO
 # scripts/ci_tier1.sh, ci_faults.sh and ci_sim.sh. The unit suites pin
-# the engine-vs-generate_fast parity oracle, teacher-forcing logits,
-# bounded prefill compilation and the params-only restore; the smoke run
-# proves `python -m gym_tpu.serve` end to end: train a tiny checkpoint,
-# serve it, answer 4 CONCURRENT requests, then the SIGTERM drill — the
-# server must exit rc=0 with a clean-shutdown line and a tokens_per_s
+# the engine-vs-generate_fast parity oracle (unpaged AND paged/prefix-
+# shared/speculative), teacher-forcing logits, bounded prefill
+# compilation and the params-only restore; the smoke run proves
+# `python -m gym_tpu.serve` end to end: train a tiny checkpoint, serve
+# it (paged by default), answer 4 CONCURRENT requests, prove PREFIX
+# SHARING live (two requests sharing a prompt prefix ->
+# prefix_hit_blocks > 0 in /stats), then the SIGTERM drill — the server
+# must exit rc=0 with a clean-shutdown line and a tokens_per_s
 # headline. CPU-only; sized for the 2-core container.
 #
 # Usage: scripts/ci_serve.sh   (from the repo root or anywhere)
@@ -16,7 +19,8 @@ REPO="$(pwd)"
 
 rm -f /tmp/_serve.log
 timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
-    tests/test_serve.py tests/test_decode.py -q -m 'not slow' \
+    tests/test_serve.py tests/test_serve_paged.py tests/test_decode.py \
+    -q -m 'not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly \
     2>&1 | tee /tmp/_serve.log
 rc=${PIPESTATUS[0]}
@@ -92,6 +96,24 @@ stats = json.loads(urllib.request.urlopen(
     f"http://127.0.0.1:{port}/stats", timeout=10).read())
 assert stats["requests_done"] == 4, stats
 print("ci_serve: tokens_per_s =", stats["tokens_per_s"])
+
+# ISSUE 7 smoke: two requests sharing a 16-token prefix (one page at
+# the default page_size 16 on this block-32 checkpoint) -> the second
+# admit must hit the prefix cache, observable via /stats
+assert stats.get("paged"), f"server not paged: {stats}"
+shared = list(range(1, 17))
+for tail in ([17], [18]):
+    body = json.dumps({"prompt": shared + tail, "max_new_tokens": 4,
+                       "top_k": 4, "seed": 9}).encode()
+    r = urllib.request.urlopen(urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate", body,
+        {"Content-Type": "application/json"}), timeout=120)
+    assert len(json.loads(r.read())["tokens"]) == 4
+stats = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/stats", timeout=10).read())
+assert stats["prefix_hit_blocks"] > 0, stats
+print("ci_serve: prefix_hit_blocks =", stats["prefix_hit_blocks"],
+      "kv_blocks_in_use =", stats["kv_blocks_in_use"])
 EOF
 rc=$?
 [ "$rc" -ne 0 ] && { echo "ci_serve: HTTP requests failed";

@@ -315,9 +315,14 @@ def test_shipped_programs_audit_clean():
     rep = audit_shipped_programs()
     assert rep["violations"] == 0, rep
     names = {p["name"] for p in rep["programs"]}
-    assert len(names) == len(rep["programs"]) >= 12
+    assert len(names) == len(rep["programs"]) >= 17
     assert any(n.startswith("serve.decode") for n in names)
     assert any(n.startswith("serve.prefill") for n in names)
+    # ISSUE 7: the paged/speculative serving programs are audited too
+    assert any(n.startswith("serve.paged_prefill") for n in names)
+    assert any(n.startswith("serve.paged_decode") for n in names)
+    assert any(n.startswith("serve.spec_decode") for n in names)
+    assert any(n.startswith("serve.cow") for n in names)
     assert rep["recompile_guard"]["n_keys"] == len(rep["programs"])
 
 

@@ -1,0 +1,612 @@
+"""Paged prefix-shared KV cache + speculative decoding (ISSUE 7).
+
+Oracles:
+- TOKEN EXACTNESS: the paged engine (speculation off) emits tokens
+  IDENTICAL to ``generate_fast`` for the same seed/sampling — including
+  padded-bucket prompts, prompts served THROUGH shared prefix blocks,
+  the copy-on-write full-hit path, and a real restored checkpoint. The
+  paged attend runs the same static-[block_size] reductions and masks
+  as the unpaged one, so the streams match bitwise.
+- SPECULATIVE EXACTNESS: the speculative engine equals the
+  non-speculative engine token-for-token — pinned greedy (the ISSUE 7
+  acceptance bar) AND under full sampling (the deterministic-draft
+  scheme samples every position from the true conditional with the
+  request's own key schedule, so drafts only decide how many samples a
+  dispatch keeps).
+- BOUNDED COMPILATION: paged prefill stays under the
+  ``⌈log2(block_size)⌉ + 1`` bucket bound; decode/draft-verify are one
+  program each.
+- ALLOCATOR: refcounts, LRU eviction of refcount-0 cached blocks,
+  double-free detection, pool-exhaustion requeue (requests wait, never
+  fail), and release returning every non-cached block.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from gym_tpu.models.nanogpt import GPT, GPTConfig, generate_fast
+from gym_tpu.serve.engine import (BlockAllocator, InferenceEngine,
+                                  NoFreeBlocksError, SamplingParams,
+                                  max_prefill_buckets)
+from gym_tpu.serve.metrics import ServeMetrics, read_headline
+from gym_tpu.serve.scheduler import RequestStatus, Scheduler
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = GPTConfig(block_size=64, vocab_size=48, n_layer=2, n_head=2,
+                    n_embd=32, dropout=0.0, bias=True)
+    model = GPT(cfg)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        np.zeros((1, 8), np.int64), train=False)["params"]
+    return cfg, model, params
+
+
+def _prompt(n, seed, vocab=48):
+    return np.asarray(jax.random.randint(jax.random.PRNGKey(seed), (n,),
+                                         0, vocab))
+
+
+def _run_one(eng, prompt, sp):
+    """Admit one request and drain it; returns its token stream."""
+    slot, ev = eng.admit(prompt, sp)
+    toks = [ev.token]
+    while not ev.finished:
+        evs = [e for e in eng.step() if e.slot == slot]
+        toks.extend(e.token for e in evs)
+        ev = evs[-1]
+    return toks
+
+
+def _drain(sched, handles, limit=5000):
+    for _ in range(limit):
+        if all(h.status in (RequestStatus.DONE, RequestStatus.FAILED)
+               for h in handles):
+            return
+        sched.step()
+    raise AssertionError("scheduler did not drain")
+
+
+# -- paged-engine token exactness ------------------------------------------
+
+
+@pytest.mark.parametrize("plen,mnew,kw", [
+    (8, 10, dict(temperature=0.8, top_k=5, seed=3)),
+    (11, 7, dict(top_p=0.9, seed=5)),          # padded prefill bucket
+    (16, 5, dict(top_k=1, seed=2)),            # greedy, block-aligned
+])
+def test_paged_matches_generate_fast(setup, plen, mnew, kw):
+    cfg, model, params = setup
+    prompt = _prompt(plen, plen)
+    ref = generate_fast(params, cfg, prompt[None], mnew, **kw)
+    eng = InferenceEngine(params, cfg, num_slots=2, paged=True,
+                          page_size=8)
+    got = _run_one(eng, prompt, SamplingParams(max_new_tokens=mnew, **kw))
+    assert got == ref[0, plen:].tolist()
+
+
+def test_prefix_sharing_admits_without_reprefill_and_stays_exact(setup):
+    """Two prompts sharing a 24-token prefix (3 pages of 8): the second
+    admit reuses the resident blocks (prefix_hit_blocks ticks, prefill
+    shrinks to the suffix bucket) and BOTH streams equal their solo
+    generate_fast runs — sharing is copy-free AND bit-exact."""
+    cfg, model, params = setup
+    shared = _prompt(24, 70)
+    pa = np.concatenate([shared, _prompt(4, 71)])
+    pb = np.concatenate([shared, _prompt(4, 72)])
+    eng = InferenceEngine(params, cfg, num_slots=2, paged=True,
+                          page_size=8)
+    ra = _run_one(eng, pa, SamplingParams(max_new_tokens=6,
+                                          temperature=0.8, top_k=5,
+                                          seed=1))
+    assert eng.stats.prefix_hit_blocks == 0      # cold cache: no hits yet
+    tokens_first = eng.stats.prefill_tokens
+    rb = _run_one(eng, pb, SamplingParams(max_new_tokens=6,
+                                          temperature=0.8, top_k=5,
+                                          seed=2))
+    assert eng.stats.prefix_hit_blocks == 3
+    # 28-token prompt, 24 shared -> only the 4-token suffix (bucket 4)
+    # is prefilled; the PR-4 engine would redo all 28 (bucket 32)
+    assert eng.stats.prefill_tokens - tokens_first == 4
+    assert ra == generate_fast(params, cfg, pa[None], 6, temperature=0.8,
+                               top_k=5, seed=1)[0, 28:].tolist()
+    assert rb == generate_fast(params, cfg, pb[None], 6, temperature=0.8,
+                               top_k=5, seed=2)[0, 28:].tolist()
+
+
+def test_full_block_aligned_hit_takes_cow_path(setup):
+    """A fully block-aligned resident prompt re-admits through
+    copy-on-write: one page copy + a 1-token prefill (the last prompt
+    token is re-forwarded for the first-token logits), and the stream
+    stays exact. The shared source page is NOT perturbed: a third
+    request over the same prefix is exact too."""
+    cfg, model, params = setup
+    p16 = _prompt(16, 80)
+    eng = InferenceEngine(params, cfg, num_slots=2, paged=True,
+                          page_size=8)
+    r1 = _run_one(eng, p16, SamplingParams(max_new_tokens=5, top_k=4,
+                                           seed=3))
+    before = eng.stats.prefill_tokens
+    r2 = _run_one(eng, p16, SamplingParams(max_new_tokens=5, top_k=4,
+                                           seed=4))
+    assert eng.stats.prefill_tokens - before == 1     # CoW: 1-token bucket
+    assert eng.stats.prefix_hit_blocks == 2           # 1 shared + 1 CoW'd
+    r3 = _run_one(eng, p16, SamplingParams(max_new_tokens=5, top_k=4,
+                                           seed=3))
+    for r, seed in ((r1, 3), (r2, 4), (r3, 3)):
+        assert r == generate_fast(params, cfg, p16[None], 5, top_k=4,
+                                  seed=seed)[0, 16:].tolist()
+
+
+def test_paged_concurrent_churn_isolated_and_blocks_freed(setup):
+    """5 mixed requests through 2 slots over ONE shared pool: every
+    stream equals its solo generate_fast run (pages cannot leak across
+    slots) and the pool drains back to zero live blocks."""
+    cfg, model, params = setup
+    eng = InferenceEngine(params, cfg, num_slots=2, decode_chunk=4,
+                          paged=True, page_size=8)
+    sched = Scheduler(eng, max_queue=8)
+    handles, wants = [], []
+    for i, (plen, mnew) in enumerate([(5, 7), (9, 12), (3, 4), (17, 9),
+                                      (8, 15)]):
+        prompt = _prompt(plen, 100 + i)
+        ref = generate_fast(params, cfg, prompt[None], mnew,
+                            temperature=0.9, top_k=7, top_p=0.95, seed=i)
+        wants.append(ref[0, plen:].tolist())
+        handles.append(sched.submit(prompt, SamplingParams(
+            max_new_tokens=mnew, temperature=0.9, top_k=7, top_p=0.95,
+            seed=i)))
+    _drain(sched, handles)
+    for h, want in zip(handles, wants):
+        assert h.result(timeout=1) == want
+    assert eng.stats.kv_blocks_in_use == 0
+
+
+def test_paged_restored_checkpoint_serves_exactly(setup, tmp_path):
+    """The paged oracle holds on a REAL restored checkpoint, not just
+    hand-built params (ISSUE 7 acceptance)."""
+    from gym_tpu import Trainer
+    from gym_tpu.data import ArrayDataset
+    from gym_tpu.serve.load import load_for_serving
+    from gym_tpu.strategy.optim import OptimSpec
+    from gym_tpu.strategy.simple_reduce import SimpleReduceStrategy
+
+    cfg = GPTConfig(block_size=32, vocab_size=48, n_layer=2, n_head=2,
+                    n_embd=32, dropout=0.0)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 48, (64, 33))
+    ds = ArrayDataset(toks[:, :-1].astype(np.int64),
+                      toks[:, 1:].astype(np.int64))
+    Trainer(GPT(cfg), ds).fit(
+        strategy=SimpleReduceStrategy(optim_spec=OptimSpec("adamw",
+                                                           lr=1e-3)),
+        num_nodes=1, max_steps=4, batch_size=4, val_size=0,
+        val_interval=0, show_progress=False, seed=1,
+        checkpoint_interval=4, save_dir=str(tmp_path / "ckpts"),
+        run_name="paged", log_dir=str(tmp_path / "logs"))
+    params, lcfg, _ = load_for_serving(str(tmp_path / "ckpts" / "paged"))
+    prompt = _prompt(9, 4, vocab=lcfg.vocab_size)
+    ref = generate_fast(params, lcfg, prompt[None], 8, temperature=0.7,
+                        top_k=8, seed=2)
+    eng = InferenceEngine(params, lcfg, num_slots=2, paged=True,
+                          page_size=8)
+    got = _run_one(eng, prompt, SamplingParams(max_new_tokens=8,
+                                               temperature=0.7, top_k=8,
+                                               seed=2))
+    assert got == ref[0, 9:].tolist()
+
+
+def test_paged_teacher_forcing_logits_match_dense_forward(setup):
+    """override_tokens still forces a chunk-1 program on the paged
+    engine; per-step logits equal the dense forward."""
+    cfg, model, params = setup
+    seq = _prompt(12, 9)[None]
+    full = np.asarray(model.apply({"params": params}, seq, train=False))
+    eng = InferenceEngine(params, cfg, num_slots=2, decode_chunk=4,
+                          paged=True, page_size=8)
+    slot, _ = eng.admit(seq[0, :5], SamplingParams(max_new_tokens=12))
+    eng.step(override_tokens={slot: int(seq[0, 5])})
+    np.testing.assert_allclose(eng.last_logits[slot], full[0, 5],
+                               rtol=1e-4, atol=1e-5)
+
+
+# -- speculative decoding --------------------------------------------------
+
+
+def test_speculative_greedy_exact_vs_nonspeculative(setup):
+    """The ISSUE 7 pinned oracle: speculative greedy == non-speculative
+    greedy == generate_fast greedy."""
+    cfg, model, params = setup
+    prompt = _prompt(9, 13)
+    ref = generate_fast(params, cfg, prompt[None], 14, top_k=1,
+                        seed=6)[0, 9:].tolist()
+    plain = InferenceEngine(params, cfg, num_slots=2, paged=True,
+                            page_size=8, decode_chunk=2)
+    spec = InferenceEngine(params, cfg, num_slots=2, paged=True,
+                           page_size=8, decode_chunk=2, spec_tokens=4)
+    sp = SamplingParams(max_new_tokens=14, top_k=1, seed=6)
+    got_plain = _run_one(plain, prompt, sp)
+    got_spec = _run_one(spec, prompt, sp)
+    assert got_plain == ref
+    assert got_spec == ref
+    # greedy self-drafting on a tiny model actually accepts drafts —
+    # the speedup lever is real, not vacuously exact
+    assert spec.stats.spec_drafted > 0
+    assert spec.stats.spec_accepted > 0
+    assert spec.stats.spec_accept_rate() > 0
+
+
+@pytest.mark.parametrize("kw", [
+    dict(temperature=0.9, top_k=7, seed=5),
+    dict(temperature=1.1, top_p=0.9, seed=8),
+])
+def test_speculative_sampling_exact_vs_nonspeculative(setup, kw):
+    """Stronger than the acceptance bar: the deterministic-draft scheme
+    is exact for EVERY sampling configuration (each position is sampled
+    from the true conditional with the request's own fold_in key), not
+    just greedy."""
+    cfg, model, params = setup
+    prompt = _prompt(10, 21)
+    ref = generate_fast(params, cfg, prompt[None], 12,
+                        **kw)[0, 10:].tolist()
+    spec = InferenceEngine(params, cfg, num_slots=2, paged=True,
+                           page_size=8, decode_chunk=3, spec_tokens=3)
+    got = _run_one(spec, prompt, SamplingParams(max_new_tokens=12, **kw))
+    assert got == ref
+
+
+def test_speculative_eos_mid_chunk(setup):
+    """EOS inside an accepted draft run stops the request at the EOS
+    token (inclusive), exactly like non-speculative decoding."""
+    cfg, model, params = setup
+    prompt = _prompt(9, 3)
+    ref = generate_fast(params, cfg, prompt[None], 12, temperature=0.9,
+                        top_k=7, seed=1)[0, 9:].tolist()
+    eos = ref[4]
+    assert eos not in ref[:4]
+    eng = InferenceEngine(params, cfg, num_slots=2, paged=True,
+                          page_size=8, decode_chunk=4, spec_tokens=3)
+    got = _run_one(eng, prompt, SamplingParams(
+        max_new_tokens=12, temperature=0.9, top_k=7, seed=1,
+        eos_token=eos))
+    assert got == ref[:5]
+
+
+def test_speculative_requires_paged(setup):
+    cfg, model, params = setup
+    with pytest.raises(ValueError, match="paged"):
+        InferenceEngine(params, cfg, num_slots=2, spec_tokens=2)
+
+
+# -- bounded compilation ---------------------------------------------------
+
+
+def test_paged_prefill_compile_bound(setup):
+    """32 distinct prompt lengths through the paged engine compile at
+    most ⌈log2(block_size)⌉ + 1 prefill programs; decode and the fused
+    draft/verify are one program each (their LRU builders are keyed on
+    (config, slots, chunk[, γ]) only)."""
+    cfg, model, params = setup
+    from gym_tpu.serve.engine import (_paged_decode_program,
+                                      _spec_decode_program)
+    eng = InferenceEngine(params, cfg, num_slots=2, paged=True,
+                          page_size=8)
+    sched = Scheduler(eng, max_queue=64)
+    handles = [sched.submit(_prompt(n, 200 + n),
+                            SamplingParams(max_new_tokens=2, seed=n))
+               for n in range(1, 33)]
+    _drain(sched, handles)
+    for h in handles:
+        assert len(h.result(timeout=1)) == 2
+    bound = max_prefill_buckets(cfg.block_size)
+    assert eng.stats.prefill_compiles <= bound
+    assert len(eng.stats.prefill_buckets) <= bound
+    # one decode program per (config, slots, chunk); one spec program
+    # per (config, slots, chunk, γ) — the engines above share them
+    eng2 = InferenceEngine(params, cfg, num_slots=2, paged=True,
+                           page_size=8)
+    assert eng2._decode_prog is eng._decode_prog
+    s1 = InferenceEngine(params, cfg, num_slots=2, paged=True,
+                         page_size=8, spec_tokens=3)
+    s2 = InferenceEngine(params, cfg, num_slots=2, paged=True,
+                         page_size=8, spec_tokens=3)
+    assert s1._spec_prog is s2._spec_prog
+    assert _paged_decode_program.cache_info().currsize >= 1
+    assert _spec_decode_program.cache_info().currsize >= 1
+
+
+# -- allocator semantics ---------------------------------------------------
+
+
+def test_allocator_refcount_and_free_list():
+    al = BlockAllocator(num_pages=5, page_size=4)
+    a, b = al.alloc(), al.alloc()
+    assert a != b and 0 not in (a, b)
+    assert al.in_use() == 2 and al.available() == 2
+    al.incref(a)
+    al.decref(a)
+    assert al.in_use() == 2                  # still referenced once
+    al.decref(a)
+    assert al.in_use() == 1 and al.available() == 3
+    with pytest.raises(ValueError, match="double-freed"):
+        al.decref(a)
+    al.decref(b)
+    assert al.available() == 4
+
+
+def test_allocator_prefix_cache_lru_eviction():
+    """Cached refcount-0 blocks stay resident and are evicted LRU when
+    the free list runs dry; a resident block's chain survives a child
+    eviction but a parent eviction orphans (and never falsely serves)
+    its children."""
+    al = BlockAllocator(num_pages=4, page_size=2)      # 3 real pages
+    blk = lambda s: s.encode()  # noqa: E731
+    p1 = al.alloc()
+    c1 = al.register(0, blk("aa"), p1)
+    p2 = al.alloc()
+    c2 = al.register(c1, blk("bb"), p2)
+    al.decref(p1)
+    al.decref(p2)
+    assert al.cached() == 2 and al.available() == 3
+    assert al.lookup(0, blk("aa"))[0] == p1
+    assert al.lookup(c1, blk("bb"))[0] == p2
+    # exhaust the pool: the third page comes from the free list, the
+    # fourth evicts the LRU cached page — "aa" was refreshed by the
+    # lookup above, so "bb"... was too (later); evict order follows
+    # recency: "aa" then "bb"
+    p3 = al.alloc()
+    p4 = al.alloc()
+    assert {p3, p4} & {p1, p2}               # reused a cached page
+    assert al.cached() == 1
+    p5 = al.alloc()                          # evicts the last cached page
+    assert al.cached() == 0
+    with pytest.raises(NoFreeBlocksError):
+        al.alloc()                           # everything referenced now
+    # "aa" (LRU) was evicted first and can never be falsely served; the
+    # orphaned child "bb" chain entry is unreachable from the root walk
+    assert al.probe(0, blk("aa")) is None
+    al.decref(p3)
+    al.decref(p4)
+    al.decref(p5)
+    assert c2 != c1
+
+
+def test_pool_exhaustion_queues_instead_of_failing(setup):
+    """A pool too small for every slot at once: requests WAIT for blocks
+    (NoFreeBlocksError is internal backpressure, not a failure) and all
+    complete exactly."""
+    cfg, model, params = setup
+    # 9 real pages of 8 tokens; each 24+16-token request reserves 5
+    # blocks, so two can never run concurrently despite 2 free slots
+    eng = InferenceEngine(params, cfg, num_slots=2, paged=True,
+                          page_size=8, kv_pages=10)
+    sched = Scheduler(eng, max_queue=8)
+    handles, wants = [], []
+    for i in range(4):
+        prompt = _prompt(24, 300 + i)
+        ref = generate_fast(params, cfg, prompt[None], 16,
+                            temperature=0.9, top_k=7, seed=i)
+        wants.append(ref[0, 24:].tolist())
+        handles.append(sched.submit(prompt, SamplingParams(
+            max_new_tokens=16, temperature=0.9, top_k=7, seed=i)))
+    _drain(sched, handles)
+    for h, want in zip(handles, wants):
+        assert h.result(timeout=1) == want
+    assert eng.stats.kv_blocks_in_use == 0
+    assert eng.stats.active_slots == 0
+
+
+def test_undersized_pool_rejected_at_construction(setup):
+    """The constructor refuses a pool that couldn't serve even one full
+    window (null + window + CoW headroom) — with that floor, EVERY
+    request that passes the block_size validation also fits an idle
+    pool, so a queued request can wait but never deadlock."""
+    cfg, model, params = setup
+    with pytest.raises(ValueError, match="kv_pages"):
+        InferenceEngine(params, cfg, num_slots=1, paged=True,
+                        page_size=8, kv_pages=9)      # needs >= 10
+    eng = InferenceEngine(params, cfg, num_slots=4, paged=True,
+                          page_size=8, kv_pages=10)   # minimum pool
+    # worst-case full-window request still fits the minimal pool
+    eng.validate(_prompt(32, 0), SamplingParams(max_new_tokens=32))
+
+
+def test_paged_nan_quarantine_catches_slot_finishing_mid_chunk(setup):
+    """Regression (review): the paged decode redirects a FINISHED row's
+    block table to the null page, so the unpaged trick of reading the
+    last scanned step's logits cannot witness a poison that struck
+    mid-chunk — the programs must LATCH non-finite logits per iteration
+    instead. Poison one slot's own pages, let it finish at iteration 2
+    of a 4-step chunk: its tokens must come back poisoned (and the
+    neighbor slot untouched)."""
+    import jax.numpy as jnp
+
+    cfg, model, params = setup
+    eng = InferenceEngine(params, cfg, num_slots=2, paged=True,
+                          page_size=8, decode_chunk=4)
+    slot, _ = eng.admit(_prompt(8, 1), SamplingParams(max_new_tokens=3))
+    other, _ = eng.admit(_prompt(6, 2), SamplingParams(max_new_tokens=8))
+    pg = int(eng._bt[slot, 0])
+    eng._cache = jax.tree.map(lambda x: x.at[pg].set(jnp.nan), eng._cache)
+    evs = eng.step()
+    mine = [e for e in evs if e.slot == slot]
+    assert mine and all(e.poisoned for e in mine)
+    assert eng.stats.quarantined == 1
+    assert all(not e.poisoned for e in evs if e.slot == other)
+    assert eng.stats.kv_blocks_in_use > 0     # neighbor still holds pages
+
+
+def test_failed_admission_releases_every_block(setup):
+    """Regression (review): an exception inside the paged admission
+    (here: an injected prefill fault) must unwind every pinned/allocated
+    page — a failed request cannot permanently shrink the pool."""
+    from gym_tpu.utils.resilience import faults
+
+    cfg, model, params = setup
+    eng = InferenceEngine(params, cfg, num_slots=2, paged=True,
+                          page_size=8)
+    # seed the prefix cache so the failing admission also PINS hit pages
+    _run_one(eng, _prompt(16, 60), SamplingParams(max_new_tokens=2))
+    assert eng.stats.kv_blocks_in_use == 0
+    cached_before = eng.stats.kv_blocks_cached
+    faults.reset()
+    faults.configure("serve.prefill:oserror")
+    try:
+        with pytest.raises(OSError):
+            eng.admit(np.concatenate([_prompt(16, 60), _prompt(4, 61)]),
+                      SamplingParams(max_new_tokens=4))
+    finally:
+        faults.reset()
+    assert eng.stats.kv_blocks_in_use == 0
+    assert eng.stats.kv_blocks_cached == cached_before
+    # the pool still serves a full-window request afterwards
+    got = _run_one(eng, _prompt(24, 62), SamplingParams(max_new_tokens=4,
+                                                        top_k=3, seed=7))
+    assert len(got) == 4
+
+
+def test_starvation_guard_admits_blocked_head(setup):
+    """Regression (review): a large-block-need head request must not be
+    starved forever by a stream of small requests that keep the pool
+    partially pinned — after `starvation_rounds` skipped rounds the
+    scheduler holds admissions until the head fits."""
+    cfg, model, params = setup
+    eng = InferenceEngine(params, cfg, num_slots=2, paged=True,
+                          page_size=8, kv_pages=10)    # 9 real pages
+    sched = Scheduler(eng, max_queue=32, prefix_window=4,
+                      starvation_rounds=2)
+    # head needs the WHOLE pool (8 blocks); smalls need 2 each, with
+    # staggered lengths so the two slots never drain simultaneously
+    big = sched.submit(_prompt(40, 1), SamplingParams(max_new_tokens=24,
+                                                      seed=1))
+    smalls = [sched.submit(_prompt(8, 10 + i),
+                           SamplingParams(max_new_tokens=6 + 2 * i,
+                                          seed=i))
+              for i in range(6)]
+    for _ in range(3000):
+        sched.step()
+        if big.status is not RequestStatus.QUEUED:
+            break
+    assert big.status is not RequestStatus.QUEUED
+    _drain(sched, [big] + smalls)
+    assert len(big.result(timeout=1)) == 24
+    for i, h in enumerate(smalls):
+        assert len(h.result(timeout=1)) == 6 + 2 * i
+    assert eng.stats.kv_blocks_in_use == 0
+
+
+def test_starvation_guard_covers_prefix_priority(setup):
+    """Regression (review): the guard must also bound being outscored —
+    a cold-prefix head under a sustained hot-prefix stream would
+    otherwise never win the window (it always HAS capacity, so the
+    capacity-only guard never armed)."""
+    cfg, model, params = setup
+    shared = _prompt(16, 97)
+    eng = InferenceEngine(params, cfg, num_slots=1, paged=True,
+                          page_size=8)
+    sched = Scheduler(eng, max_queue=64, prefix_window=4,
+                      starvation_rounds=3)
+    warm = sched.submit(np.concatenate([shared, _prompt(2, 98)]),
+                        SamplingParams(max_new_tokens=2, seed=0))
+    _drain(sched, [warm])
+    cold = sched.submit(_prompt(18, 99), SamplingParams(
+        max_new_tokens=2, seed=1))
+    hot_seed = 200
+    hots = []
+    for _ in range(400):
+        # keep the window saturated with hot-prefix competitors
+        while sum(h.status is RequestStatus.QUEUED for h in hots) < 3:
+            hots.append(sched.submit(
+                np.concatenate([shared, _prompt(2, hot_seed)]),
+                SamplingParams(max_new_tokens=2, seed=hot_seed)))
+            hot_seed += 1
+        sched.step()
+        if cold.status is not RequestStatus.QUEUED:
+            break
+    assert cold.status is not RequestStatus.QUEUED
+    _drain(sched, [cold] + hots)
+    assert len(cold.result(timeout=1)) == 2
+
+
+def test_scheduler_prefix_aware_admit_ordering(setup):
+    """With one free slot and a cold-prefix request ahead of a
+    hot-prefix request in the queue, the hot one is admitted first
+    (within the lookahead window); on an unpaged engine the same queue
+    stays strict FCFS."""
+    cfg, model, params = setup
+    shared = _prompt(16, 90)
+    eng = InferenceEngine(params, cfg, num_slots=1, paged=True,
+                          page_size=8)
+    sched = Scheduler(eng, max_queue=8, prefix_window=4)
+    # warm the prefix cache
+    h0 = sched.submit(np.concatenate([shared, _prompt(2, 91)]),
+                      SamplingParams(max_new_tokens=2, seed=0))
+    _drain(sched, [h0])
+    cold = sched.submit(_prompt(18, 92), SamplingParams(
+        max_new_tokens=2, seed=1))
+    hot = sched.submit(np.concatenate([shared, _prompt(2, 93)]),
+                       SamplingParams(max_new_tokens=2, seed=2))
+    sched.step()                       # admits ONE request into the slot
+    assert hot.status in (RequestStatus.RUNNING, RequestStatus.DONE)
+    assert cold.status is RequestStatus.QUEUED
+    _drain(sched, [cold, hot])
+    # unpaged: all scores 0 -> FCFS preserved
+    engu = InferenceEngine(params, cfg, num_slots=1)
+    schedu = Scheduler(engu, max_queue=8, prefix_window=4)
+    first = schedu.submit(_prompt(6, 94), SamplingParams(
+        max_new_tokens=2, seed=3))
+    second = schedu.submit(_prompt(6, 95), SamplingParams(
+        max_new_tokens=2, seed=4))
+    schedu.step()
+    assert first.status in (RequestStatus.RUNNING, RequestStatus.DONE)
+    assert second.status is RequestStatus.QUEUED
+    _drain(schedu, [first, second])
+
+
+# -- observability ---------------------------------------------------------
+
+
+def test_metrics_carry_paged_and_spec_observables(setup, tmp_path):
+    """serve.csv engine rows + headline + read_headline all report
+    kv_blocks_in_use / prefix_hit_blocks / spec_accept_rate."""
+    cfg, model, params = setup
+    eng = InferenceEngine(params, cfg, num_slots=2, paged=True,
+                          page_size=8, spec_tokens=2)
+    metrics = ServeMetrics(str(tmp_path), engine_log_every=1)
+    sched = Scheduler(eng, max_queue=8, metrics=metrics)
+    shared = _prompt(16, 40)
+    hs = [sched.submit(np.concatenate([shared, _prompt(2, 41 + i)]),
+                       SamplingParams(max_new_tokens=4, seed=i))
+          for i in range(3)]
+    while any(h.status in (RequestStatus.QUEUED, RequestStatus.RUNNING)
+              for h in hs):
+        sched.step()
+        metrics.engine_tick(eng.stats, queue_depth=sched.queue_depth())
+    metrics.sync()
+    head = metrics.headline()
+    assert head["requests_done"] == 3
+    assert head["prefix_hit_blocks"] >= 2      # requests 2 and 3 hit
+    assert head["spec_accept_rate"] is not None
+    with open(os.path.join(str(tmp_path), "serve.csv")) as f:
+        header = f.readline().strip().split(",")
+    for col in ("kv_blocks_in_use", "prefix_hit_blocks",
+                "spec_accept_rate"):
+        assert col in header
+    post = read_headline(os.path.join(str(tmp_path), "serve.csv"))
+    assert post["prefix_hit_blocks"] == head["prefix_hit_blocks"]
+    assert post["spec_accept_rate"] is not None
+    metrics.close()
+
+
+def test_unpaged_engine_reports_zero_paged_stats(setup):
+    cfg, model, params = setup
+    eng = InferenceEngine(params, cfg, num_slots=2)
+    _run_one(eng, _prompt(6, 1), SamplingParams(max_new_tokens=3))
+    assert eng.stats.kv_blocks_in_use == 0
+    assert eng.stats.prefix_hit_blocks == 0
+    assert eng.stats.spec_accept_rate() is None
+    assert eng.stats.prefill_tokens == 8       # bucket(6) — comparable
