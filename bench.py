@@ -74,6 +74,84 @@ def _timeout_tail(e: subprocess.TimeoutExpired) -> str:
     return out + err
 
 
+def _artifact_status(obj) -> tuple:
+    """Classify one bench artifact as (result_dict_or_None, status).
+    Accepts both the raw one-JSON-line result and the baseline runner's
+    wrapper (``BENCH_rNN.json``: ``{"n", "cmd", "rc", "tail",
+    "parsed"}``). Artifacts predating the explicit ``status`` field
+    (r01–r03) are grandfathered: a parsed result carrying ``value`` and
+    no ``error`` was a measurement; anything else is ``not_measured``."""
+    if isinstance(obj, dict) and "parsed" in obj:
+        obj = obj["parsed"]
+    if not isinstance(obj, dict):
+        return None, "not_measured"
+    if obj.get("status"):
+        return obj, obj["status"]
+    if obj.get("error"):
+        return obj, "not_measured"
+    if "value" in obj:
+        return obj, "measured"
+    return obj, "not_measured"
+
+
+def compare_runs(path_a: str, path_b: str) -> dict:
+    """``bench.py --compare A.json B.json`` — the ONLY sanctioned way to
+    turn two bench artifacts into a speedup. Refuses (one-line
+    ``not_comparable`` note, exit 0) when EITHER arm's status is not
+    ``measured``: r04/r05 recorded ``tpu_unavailable`` markers, and
+    dividing a marker by a measurement is how a dead transport gets
+    reported as a 100% regression (the ROADMAP perf-trajectory
+    caveat this closes)."""
+    arms = {}
+    for name, path in (("a", path_a), ("b", path_b)):
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+            obj, status = _artifact_status(raw)
+        except (OSError, json.JSONDecodeError) as e:
+            obj, status = None, "not_measured"
+            arms[name] = {"path": path, "status": status,
+                          "error": f"{type(e).__name__}: {e}"}
+            continue
+        arms[name] = {"path": path, "status": status,
+                      "value": (obj or {}).get("value"),
+                      "metric": (obj or {}).get("metric"),
+                      "error": (obj or {}).get("error")}
+    a, b = arms["a"], arms["b"]
+    out = {"mode": "compare", "a": a, "b": b}
+
+    def numeric(v):
+        return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+    bad = [n for n in ("a", "b") if arms[n]["status"] != "measured"
+           or not numeric(arms[n].get("value"))
+           or arms[n]["value"] == 0]
+    if bad:
+        out["comparable"] = False
+        out["note"] = "not_comparable"
+        out["reason"] = "; ".join(
+            f"arm {n} ({arms[n]['path']}): status="
+            f"{arms[n]['status']}"
+            + ("" if numeric(arms[n].get("value"))
+               else f", value={arms[n].get('value')!r}")
+            + (f", error={arms[n]['error']}" if arms[n].get("error")
+               else "")
+            for n in bad)
+        return out
+    if (a.get("metric") and b.get("metric")
+            and a["metric"] != b["metric"]):
+        # dividing steps/s by, say, sim-seconds is a confidently wrong
+        # number (and inverted for lower-is-better metrics)
+        out["comparable"] = False
+        out["note"] = "not_comparable"
+        out["reason"] = (f"metric mismatch: a={a['metric']!r} "
+                         f"b={b['metric']!r}")
+        return out
+    out["comparable"] = True
+    out["speedup"] = round(b["value"] / a["value"], 3)
+    return out
+
+
 def _classify_and_report(blob: str, detail: str) -> int:
     err = ("tpu_unavailable" if any(m in blob for m in _UNAVAILABLE_MARKERS)
            else "bench_failure")
@@ -83,11 +161,13 @@ def _classify_and_report(blob: str, detail: str) -> int:
 
 def _supervise() -> int:
     """Probe the accelerator, then run the measurement under a watchdog."""
-    # --sim-only / --chaos-only / --analyze-only are host-side by
-    # construction (modeled network; injected host faults; abstract
-    # tracing) — never touch the accelerator
+    # --sim-only / --chaos-only / --fleet-only / --analyze-only are
+    # host-side by construction (modeled network; injected host faults;
+    # in-process replica fleet; abstract tracing) — never touch the
+    # accelerator
     force_cpu = ("--cpu" in sys.argv or "--sim-only" in sys.argv
                  or "--chaos-only" in sys.argv
+                 or "--fleet-only" in sys.argv
                  or "--analyze-only" in sys.argv)
     if not force_cpu:
         probe_cmd = [sys.executable, "-c",
@@ -776,6 +856,178 @@ def measure_chaos() -> dict:
     }
 
 
+def measure_fleet() -> dict:
+    """The ISSUE 8 rider: the 2-replica fleet under fire — (a) a
+    replica KILLED mid-stream under concurrent traffic (hard engine
+    death: every dispatch raises, restart budget 0) with every client
+    request still answered via sibling failover, and (b) a rolling
+    weight HOT-SWAP under sustained traffic with zero failed requests,
+    zero XLA recompiles (global program LRUs, pinned by lru cache-miss
+    deltas) and post-swap generations provably from the new params.
+    Host-side by construction; always CPU-forced like --chaos-only."""
+    import concurrent.futures
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from gym_tpu.models.nanogpt import GPT, GPTConfig, generate_fast
+    from gym_tpu.serve import engine as engine_mod
+    from gym_tpu.serve.engine import InferenceEngine, SamplingParams
+    from gym_tpu.serve.metrics import ServeMetrics
+    from gym_tpu.serve.router import build_fleet
+
+    import jax
+
+    n_req = int(os.environ.get("GYM_TPU_BENCH_FLEET_REQUESTS", 16))
+    cfg = GPTConfig(block_size=128, vocab_size=65, n_layer=2, n_head=2,
+                    n_embd=64, dropout=0.0, bias=True)
+    model = GPT(cfg)
+    params_a = model.init({"params": jax.random.PRNGKey(0)},
+                          np.zeros((1, 8), np.int64), train=False)["params"]
+    params_b = model.init({"params": jax.random.PRNGKey(7)},
+                          np.zeros((1, 8), np.int64), train=False)["params"]
+
+    rng = np.random.default_rng(0)
+    workload = [
+        (rng.integers(0, cfg.vocab_size, int(rng.integers(4, 24))),
+         SamplingParams(max_new_tokens=int(rng.integers(12, 28)),
+                        temperature=0.9, top_k=16, seed=i))
+        for i in range(n_req)]
+
+    def serve_all(router, wl, kill_after=None):
+        """Drive the workload through handler-thread-style clients;
+        optionally hard-kill the busiest replica once `kill_after`
+        requests have completed. Returns (ok, failed, wall_s)."""
+        done = {"n": 0}
+
+        def client(arg):
+            prompt, sp = arg
+            try:
+                fr = router.submit(prompt, sp, timeout=60.0,
+                                   deadline_s=120.0)
+                toks = fr.result(timeout=120.0)
+                done["n"] += 1
+                return len(toks) == sp.max_new_tokens
+            except (RuntimeError, OSError):
+                return False
+
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(4) as ex:
+            futs = [ex.submit(client, w) for w in wl]
+            if kill_after is not None:
+                while done["n"] < kill_after:
+                    time.sleep(0.01)
+                victim = max(router.replicas,
+                             key=lambda r: r.scheduler.backlog_tokens())
+
+                def boom(*a, **k):
+                    raise RuntimeError(
+                        "bench: injected hard engine death")
+
+                victim.scheduler.engine.step = boom
+            results = [f.result() for f in futs]
+        ok = sum(results)
+        return ok, len(results) - ok, time.perf_counter() - t0
+
+    def fresh_router(max_restarts):
+        m = ServeMetrics(tempfile.mkdtemp(prefix="gym_tpu_fleet_"),
+                         engine_log_every=10)
+        r = build_fleet(params_a, cfg, replicas=2, num_slots=4,
+                        decode_chunk=2, max_restarts=max_restarts,
+                        dispatch_timeout_s=5.0, metrics=m,
+                        weights_tag="v1",
+                        log=lambda *a, **k: None).start()
+        return r, m
+
+    # warm the programs once so neither arm absorbs a compile
+    warm, wm = fresh_router(max_restarts=2)
+    serve_all(warm, workload[:4])
+    warm.close(drain_deadline_s=30)
+    wm.close()
+
+    # arm (a): replica kill mid-traffic, restart budget exhausted
+    router, m = fresh_router(max_restarts=0)
+    ok, failed, wall = serve_all(router, workload, kill_after=2)
+    kill_status = router.status()
+    assert kill_status["failovers"] >= 1, kill_status
+    assert sum(r["dead"] for r in kill_status["replicas"]) == 1, \
+        kill_status
+    kill_arm = {
+        "requests_ok": ok,
+        "requests_failed": failed,
+        "failovers": kill_status["failovers"],
+        "dead_replicas": sum(r["dead"]
+                             for r in kill_status["replicas"]),
+        "tok_s": round(sum(sp.max_new_tokens
+                           for _, sp in workload) / wall, 1),
+    }
+    router.close(drain_deadline_s=30)
+    m.close()
+
+    # arm (b): rolling hot-swap under sustained traffic
+    router, m = fresh_router(max_restarts=2)
+    probe = workload[0]
+    ref_b = generate_fast(params_b, cfg, probe[0][None],
+                          probe[1].max_new_tokens, temperature=0.9,
+                          top_k=16, seed=probe[1].seed
+                          )[0, len(probe[0]):].tolist()
+    compiles_before = (
+        engine_mod._prefill_program.cache_info().misses
+        + engine_mod._paged_prefill_program.cache_info().misses
+        + engine_mod._slot_programs.cache_info().misses
+        + engine_mod._paged_decode_program.cache_info().misses)
+    reload_result = {}
+
+    def do_reload():
+        time.sleep(0.15)      # let traffic occupy both replicas first
+        reload_result.update(router.reload(params_b, weights_tag="v2",
+                                           drain_timeout_s=60.0))
+
+    swapper = threading.Thread(target=do_reload)
+    swapper.start()
+    ok, failed, wall = serve_all(router, workload * 2)
+    swapper.join(timeout=120)
+    compiles_after = (
+        engine_mod._prefill_program.cache_info().misses
+        + engine_mod._paged_prefill_program.cache_info().misses
+        + engine_mod._slot_programs.cache_info().misses
+        + engine_mod._paged_decode_program.cache_info().misses)
+    fr = router.submit(probe[0], probe[1], timeout=60.0)
+    post_tokens = fr.result(timeout=120.0)
+    assert failed == 0, f"hot-swap dropped {failed} requests"
+    assert sorted(reload_result.get("swapped", [])) == [0, 1], \
+        reload_result
+    assert compiles_after == compiles_before, (
+        f"hot-swap recompiled: {compiles_after - compiles_before} "
+        f"new program(s)")
+    assert post_tokens == ref_b, "post-swap tokens not from new params"
+    swap_arm = {
+        "requests_ok": ok,
+        "requests_failed": failed,
+        "reload_wall_s": reload_result.get("wall_s"),
+        "swapped_replicas": reload_result.get("swapped"),
+        "recompiles_during_swap": compiles_after - compiles_before,
+        "post_swap_params_verified": post_tokens == ref_b,
+        "tok_s": round(sum(sp.max_new_tokens
+                           for _, sp in workload * 2) / wall, 1),
+    }
+    router.close(drain_deadline_s=30)
+    m.close()
+
+    return {
+        "metric": "fleet_failover_and_hot_swap",
+        "status": "measured",
+        "measured": True,
+        "workload": (f"{n_req} requests (prompt_len in [4,24), max_new "
+                     f"in [12,28)), gpt {cfg.n_layer}L/{cfg.n_embd}d "
+                     f"block {cfg.block_size}, 2 replicas x 4 slots, "
+                     f"chunk 2"),
+        "replica_kill": kill_arm,
+        "hot_swap": swap_arm,
+    }
+
+
 def measure_analysis() -> dict:
     """Static-analysis summary (ISSUE 6): the full suite — lint, static
     trace reconciliation, jaxpr audit — as one JSON line, the
@@ -802,6 +1054,7 @@ def measure_analysis() -> dict:
 def main() -> None:
     force_cpu = ("--cpu" in sys.argv or "--sim-only" in sys.argv
                  or "--chaos-only" in sys.argv
+                 or "--fleet-only" in sys.argv
                  or "--analyze-only" in sys.argv)
     if force_cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -841,6 +1094,10 @@ def main() -> None:
 
     if "--chaos-only" in sys.argv:
         print(json.dumps({"chaos": measure_chaos()}))
+        return
+
+    if "--fleet-only" in sys.argv:
+        print(json.dumps({"fleet": measure_fleet()}))
         return
 
     if "--analyze-only" in sys.argv:
@@ -988,6 +1245,18 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if "--compare" in sys.argv:
+        # artifact comparison is pure host-side JSON work: no jax, no
+        # probe, no supervisor child
+        i = sys.argv.index("--compare")
+        if len(sys.argv) < i + 3:
+            print(json.dumps({"mode": "compare", "comparable": False,
+                              "note": "not_comparable",
+                              "reason": "--compare needs two artifact "
+                                        "paths"}))
+            sys.exit(1)
+        print(json.dumps(compare_runs(sys.argv[i + 1], sys.argv[i + 2])))
+        sys.exit(0)
     if os.environ.get("_GYM_TPU_BENCH_CHILD"):
         main()
     else:

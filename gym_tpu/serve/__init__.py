@@ -29,6 +29,12 @@ concurrent load:
 - ``supervisor``: self-healing driver loop — every dispatch runs under a
   watchdog; an engine crash or wedge fails in-flight requests typed,
   rebuilds the engine warm (global program LRUs) and resumes the queue.
+- ``router``: the FLEET tier — N replica stacks behind health-aware
+  least-loaded + prefix-cache-affine dispatch, transparent failover of
+  in-flight requests onto a sibling under their remaining deadline when
+  a replica dies, and rolling zero-downtime weight hot-swap
+  (``Router.reload``) so a trainer's newest checkpoint enters the fleet
+  without dropping a request or recompiling a program.
 - ``load``: params-only checkpoint restore — a ``fit(save_dir=...)`` run
   dir serves directly, no optimizer-state template needed.
 - ``metrics``: per-request TTFT / per-token latency and engine
@@ -40,8 +46,10 @@ concurrent load:
 
 from .engine import (BlockAllocator, EngineStats, InferenceEngine,
                      NoFreeBlocksError, SamplingParams)
-from .load import load_for_serving
-from .metrics import ServeMetrics
+from .load import CheckpointWatcher, load_for_serving
+from .metrics import ReplicaMetrics, ServeMetrics
+from .router import (FleetReloadError, FleetRequest,
+                     NoHealthyReplicaError, Replica, Router, build_fleet)
 from .scheduler import (AdmissionRejectedError, DeadlineExceededError,
                         EngineFailedError, QueueFullError, Request,
                         RequestStatus, Scheduler, SchedulerClosedError,
@@ -55,5 +63,8 @@ __all__ = [
     "SchedulerClosedError", "DeadlineExceededError",
     "AdmissionRejectedError", "EngineFailedError",
     "SlotQuarantinedError", "Supervisor",
-    "load_for_serving", "ServeMetrics",
+    "Router", "Replica", "FleetRequest", "build_fleet",
+    "NoHealthyReplicaError", "FleetReloadError",
+    "load_for_serving", "CheckpointWatcher",
+    "ServeMetrics", "ReplicaMetrics",
 ]

@@ -18,7 +18,23 @@ Endpoints:
   ``tokens`` (and ``text`` when the vocab is char-level), TTFT and
   per-token latency.
 - ``GET /stats`` (alias ``/healthz``) — engine + metrics headline JSON,
-  including supervisor state (engine generation / restarts).
+  including supervisor state (engine generation / restarts) and, with
+  ``--replicas N``, the fleet view: per-replica health/EWMA/weights
+  sections, ``failovers``, ``healthy_replicas``, ``weight_reloads``
+  (rolling ROLLOUTS; the collector's ``engine_reloads`` counts
+  per-replica engine swaps — one rollout × N replicas).
+- ``POST /reload`` — zero-downtime weight hot-swap: re-reads the
+  checkpoint run dir (optionally ``{"ckpt": ..., "step": ...}``) and
+  rolls the new params through the replicas one at a time (drain →
+  warm rebuild through the global program LRUs → re-admit) without
+  dropping an in-flight request. ``--reload-watch S`` does the same
+  automatically whenever the trainer commits a newer checkpoint.
+
+``--replicas N`` runs N in-process engine+scheduler+supervisor stacks
+behind the health-aware router (``serve/router.py``): least-loaded +
+prefix-cache-affine dispatch, and a replica that dies mid-request has
+the request transparently retried on a sibling under its remaining
+deadline — the client sees 200, ``/stats`` sees ``failovers``.
 
 Typed failure → status mapping (never a traceback-500 for a fault the
 serving stack understands):
@@ -80,6 +96,18 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--num_slots", type=int, default=4,
                    help="concurrent decode slots (the batch width)")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="engine replicas behind the health-aware router "
+                        "(fleet serving: failover + rolling weight "
+                        "hot-swap need >= 2)")
+    p.add_argument("--failover-retries", type=int, default=None,
+                   help="per-request failover re-dispatch budget "
+                        "(default: min(2, replicas-1) — a single "
+                        "replica keeps the PR-5 typed-503 behavior)")
+    p.add_argument("--reload-watch", type=float, default=0.0,
+                   help="poll the checkpoint run dir every S seconds "
+                        "and hot-swap newer checkpoints into the fleet "
+                        "(0 = off; POST /reload always works)")
     p.add_argument("--decode_chunk", type=int, default=1,
                    help="decode steps fused per dispatch (chunk boundary "
                         "= deadline-cancellation granularity)")
@@ -127,7 +155,10 @@ def _build_parser() -> argparse.ArgumentParser:
 @dataclasses.dataclass
 class ServerHandle:
     """Everything a caller (main() or an in-process test) needs to drive
-    and tear down one serving stack."""
+    and tear down one serving stack. ``scheduler``/``supervisor``/
+    ``engine_factory`` are replica 0's (the pre-fleet surface, kept so
+    single-replica callers and tests read exactly what they always
+    did); ``router`` is the fleet."""
 
     httpd: ThreadingHTTPServer
     scheduler: Any
@@ -135,21 +166,18 @@ class ServerHandle:
     metrics: Any
     engine_factory: Any
     info: Dict[str, Any]
+    router: Any = None
 
     @property
     def port(self) -> int:
         return self.httpd.server_address[1]
 
     def close(self, drain_deadline_s: float = 30.0) -> None:
-        """Test-path teardown: stop the driver, drain, close sockets."""
-        if self.supervisor.stop(join_timeout_s=drain_deadline_s):
-            self.scheduler.shutdown(finish_running=True,
-                                    deadline_s=drain_deadline_s)
-        else:
-            # driver wedged: never step the engine from here, but DO
-            # fail queued + in-flight futures typed — handler threads
-            # blocked in result() must not pin server_close open
-            self.scheduler.shutdown(finish_running=False, deadline_s=0.0)
+        """Test-path teardown: stop every replica's driver, drain it
+        (wedged replicas get their stacks dumped and their requests
+        failed typed — handler threads blocked in result() must not pin
+        server_close open), close sockets."""
+        self.router.close(drain_deadline_s=drain_deadline_s)
         self.httpd.shutdown()
         self.httpd.server_close()
         self.metrics.close()
@@ -164,20 +192,28 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
                   info: Optional[Dict[str, Any]] = None,
                   stop_event: Optional[threading.Event] = None,
                   page_size: int = 16, kv_pages: Optional[int] = None,
-                  spec_tokens: int = 0) -> ServerHandle:
-    """Build the full serving stack — engine, scheduler, supervisor,
-    metrics, HTTP server — WITHOUT entering ``serve_forever``. ``main``
-    and the in-process chaos tests share this path, so what the tests
-    exercise is exactly what ``python -m gym_tpu.serve`` runs.
-    ``port=0`` binds an ephemeral port (``handle.port`` reports it)."""
+                  spec_tokens: int = 0, replicas: int = 1,
+                  failover_retries: Optional[int] = None,
+                  reload_source: Optional[Any] = None) -> ServerHandle:
+    """Build the full serving stack — replica fleet (engines, schedulers,
+    supervisors, router), metrics, HTTP server — WITHOUT entering
+    ``serve_forever``. ``main`` and the in-process chaos tests share
+    this path, so what the tests exercise is exactly what
+    ``python -m gym_tpu.serve`` runs. ``port=0`` binds an ephemeral
+    port (``handle.port`` reports it). ``reload_source(body) ->
+    (params, weights_tag)`` supplies ``POST /reload``'s checkpoint
+    re-read (absent: /reload answers 400; ``Router.reload`` still works
+    programmatically)."""
     from ..data.build_dataset import CHAR_VOCAB
+    from ..utils.checkpoint import CheckpointNotFoundError
     from ..utils.resilience import fault_point
-    from .engine import InferenceEngine, SamplingParams
+    from .engine import SamplingParams
     from .metrics import ServeMetrics
+    from .router import (FleetReloadError, NoHealthyReplicaError,
+                         build_fleet)
     from .scheduler import (AdmissionRejectedError, DeadlineExceededError,
-                            EngineFailedError, QueueFullError, Scheduler,
+                            EngineFailedError, QueueFullError,
                             SchedulerClosedError, SlotQuarantinedError)
-    from .supervisor import Supervisor
 
     info = dict(info or {"step": None, "num_nodes": None})
     stop = stop_event or threading.Event()
@@ -202,22 +238,22 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
             "gym_tpu.serve: --spec_tokens requires the paged cache "
             "(--page_size > 0) — speculative decoding disabled\n")
 
-    def engine_factory():
-        # the params live in memory (restored from the checkpoint at
-        # startup); the global prefill/decode program LRUs make a rebuild
-        # warm — same config, no recompiles
-        return InferenceEngine(params, cfg, num_slots=num_slots,
-                               decode_chunk=decode_chunk, paged=paged,
-                               page_size=page_size or 16,
-                               kv_pages=kv_pages,
-                               spec_tokens=spec_tokens if paged else 0)
-
     metrics = ServeMetrics(metrics_dir)
-    sched = Scheduler(engine_factory(), max_queue=max_queue,
-                      metrics=metrics)
-    sup = Supervisor(sched, engine_factory,
-                     dispatch_timeout_s=dispatch_timeout,
-                     max_restarts=max_restarts, metrics=metrics)
+    # the params live in memory (restored from the checkpoint at
+    # startup); the global prefill/decode program LRUs make every
+    # replica's engine — and any failover/hot-swap rebuild — warm:
+    # same config, no recompiles
+    router = build_fleet(
+        params, cfg, replicas=replicas, num_slots=num_slots,
+        decode_chunk=decode_chunk, paged=paged,
+        page_size=page_size or 16, kv_pages=kv_pages,
+        spec_tokens=spec_tokens if paged else 0, max_queue=max_queue,
+        metrics=metrics, dispatch_timeout_s=dispatch_timeout,
+        max_restarts=max_restarts, max_failovers=failover_retries,
+        weights_tag=(f"step-{info['step']}"
+                     if info.get("step") is not None else None))
+    rep0 = router.replicas[0]
+    sched, sup = rep0.scheduler, rep0.supervisor
     char_level = cfg.vocab_size <= len(CHAR_VOCAB) + 1
 
     def encode_text(text: str):
@@ -253,34 +289,65 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
             if self.path not in ("/stats", "/healthz"):
                 self._reply(404, {"error": f"unknown path {self.path}"})
                 return
-            s = sched.engine.stats
-            eng = sched.engine
+            fleet = router.status()
+            engines = [rep.scheduler.engine for rep in router.replicas]
+            stats = [e.stats for e in engines]
+            eng0 = engines[0]
+            buckets = sorted({b for s in stats for b in s.prefill_buckets})
+            drafted = sum(s.spec_drafted for s in stats)
+            accepted = sum(s.spec_accepted for s in stats)
+            head = metrics.headline()
+            rep_counters = head.pop("replicas", {})
+            # ONE per-replica section: live engine samples + the
+            # metrics collector's per-replica counters folded into the
+            # router's health entries
+            for entry, s in zip(fleet["replicas"], stats):
+                entry.update(active_slots=s.active_slots,
+                             tokens_generated=s.tokens_generated,
+                             quarantined=s.quarantined)
+                entry.update(rep_counters.get(str(entry["id"]), {}))
+            dead = sum(1 for rep in router.replicas if rep.dead)
             self._reply(200, {
-                **metrics.headline(),   # first: the LIVE engine stats
+                **head,                 # first: the LIVE engine stats
                 #                         below win over its tick samples
                 "status": ("draining" if stop.is_set() else
-                           "degraded" if sup.failed is not None else "ok"),
+                           "degraded" if dead else "ok"),
                 "step": info["step"],
-                "num_slots": s.num_slots,
-                "active_slots": s.active_slots,
-                "queue_depth": sched.queue_depth(),
-                "tokens_generated": s.tokens_generated,
-                "decode_steps": s.decode_steps,
-                "prefills": s.prefills,
-                "prefill_buckets": list(s.prefill_buckets),
-                "prefill_tokens": s.prefill_tokens,
-                "paged": bool(getattr(eng, "paged", False)),
-                "page_size": int(getattr(eng, "page_size", 0)),
-                "kv_pages": int(getattr(eng, "kv_pages", 0)),
-                "spec_tokens": int(getattr(eng, "spec_tokens", 0)),
-                "kv_blocks_in_use": s.kv_blocks_in_use,
-                "kv_blocks_cached": s.kv_blocks_cached,
-                "prefix_hit_blocks": s.prefix_hit_blocks,
-                "spec_accept_rate": s.spec_accept_rate(),
+                "num_slots": sum(s.num_slots for s in stats),
+                "active_slots": sum(s.active_slots for s in stats),
+                "queue_depth": sum(rep.scheduler.queue_depth()
+                                   for rep in router.replicas),
+                "tokens_generated": sum(s.tokens_generated
+                                        for s in stats),
+                "decode_steps": sum(s.decode_steps for s in stats),
+                "prefills": sum(s.prefills for s in stats),
+                "prefill_buckets": buckets,
+                "prefill_tokens": sum(s.prefill_tokens for s in stats),
+                "paged": bool(getattr(eng0, "paged", False)),
+                "page_size": int(getattr(eng0, "page_size", 0)),
+                "kv_pages": int(getattr(eng0, "kv_pages", 0)),
+                "spec_tokens": int(getattr(eng0, "spec_tokens", 0)),
+                "kv_blocks_in_use": sum(s.kv_blocks_in_use
+                                        for s in stats),
+                "kv_blocks_cached": sum(s.kv_blocks_cached
+                                        for s in stats),
+                "prefix_hit_blocks": sum(s.prefix_hit_blocks
+                                         for s in stats),
+                "spec_accept_rate": (accepted / drafted
+                                     if drafted else None),
+                # pre-fleet surface: replica 0's supervisor state (the
+                # keys every existing dashboard/drill greps)
                 **sup.status(),
+                # the fleet view: per-replica health/load/weights,
+                # failovers, reloads — wins over the aggregates above
+                # where keys collide (replicas, failovers, …)
+                **fleet,
             })
 
         def do_POST(self):
+            if self.path == "/reload":
+                self._do_reload()
+                return
             if self.path != "/generate":
                 self._reply(404, {"error": f"unknown path {self.path}"})
                 return
@@ -332,14 +399,18 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
                             retry_after_s=1.0)
                 return
             try:
-                req = sched.submit(prompt, sp, timeout=30.0,
-                                   deadline_s=deadline)
+                req = router.submit(prompt, sp, timeout=30.0,
+                                    deadline_s=deadline)
             except AdmissionRejectedError as e:
                 self._reply(429, {"error": str(e)},
                             retry_after_s=e.retry_after_s)
                 return
             except QueueFullError as e:
                 self._reply(429, {"error": str(e)}, retry_after_s=2.0)
+                return
+            except NoHealthyReplicaError as e:
+                self._reply(503, {"error": str(e)},
+                            retry_after_s=e.retry_after_s)
                 return
             except SchedulerClosedError as e:
                 self._reply(503, {"error": str(e)}, retry_after_s=10.0)
@@ -373,6 +444,20 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
                 self._reply(503, {"error": f"{type(e).__name__}: {e}"},
                             retry_after_s=2.0)
                 return
+            except AdmissionRejectedError as e:
+                # a failover retry shed at the SIBLING's admission (the
+                # remaining deadline is infeasible there): same 429 +
+                # Retry-After contract as a front-door shed
+                self._reply(429, {"error": str(e)},
+                            retry_after_s=e.retry_after_s)
+                return
+            except QueueFullError as e:
+                self._reply(429, {"error": str(e)}, retry_after_s=2.0)
+                return
+            except NoHealthyReplicaError as e:
+                self._reply(503, {"error": str(e)},
+                            retry_after_s=e.retry_after_s)
+                return
             except OSError as e:
                 # a request failed by an IO fault (e.g. serve.prefill
                 # oserror) stores that exception; it must surface as a
@@ -386,20 +471,78 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
             out = {"tokens": tokens,
                    "prompt_tokens": int(prompt.size),
                    "ttft_s": round(req.ttft_s, 5),
-                   "latency_s": round(req.done_t - req.submit_t, 5)}
+                   "latency_s": round(req.done_t - req.submit_t, 5),
+                   "replica": req.replica_id,
+                   "failovers": req.failovers}
             if char_level:
                 out["text"] = decode_text(tokens)
             self._reply(200, out)
+
+        def _do_reload(self):
+            """Zero-downtime weight hot-swap over HTTP: re-read the
+            checkpoint (body: optional ``ckpt``/``step``), roll it
+            through the fleet. 400 bad body/source, 409 when a reload
+            is already rolling, 503 when a replica failed to drain."""
+            if reload_source is None:
+                self._reply(400, {
+                    "error": "no reload source configured — start the "
+                             "server via `python -m gym_tpu.serve "
+                             "--ckpt ...` to enable /reload"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n) or b"{}"
+                body = json.loads(raw)
+                if not isinstance(body, dict):
+                    raise ValueError(
+                        f"JSON body must be an object, got "
+                        f"{type(body).__name__}")
+                drain_s = float(body.get("drain_timeout_s", 300.0))
+            except (json.JSONDecodeError, ValueError, TypeError) as e:
+                self._reply(400, {"error": f"malformed reload body: {e}"})
+                return
+            try:
+                new_params, tag = reload_source(body)
+            except (CheckpointNotFoundError, FileNotFoundError,
+                    ValueError) as e:
+                self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+                return
+            except OSError as e:
+                self._reply(503, {"error": f"{type(e).__name__}: {e}"},
+                            retry_after_s=5.0)
+                return
+            try:
+                result = router.reload(
+                    new_params, weights_tag=tag, drain_timeout_s=drain_s)
+            except FleetReloadError as e:
+                if e.retry_after_s is not None:
+                    # a replica failed to drain in time — transient
+                    self._reply(503, {"error": str(e)},
+                                retry_after_s=e.retry_after_s)
+                else:       # another rollout already in flight
+                    self._reply(409, {"error": str(e)})
+                return
+            except SchedulerClosedError as e:
+                self._reply(503, {"error": str(e)}, retry_after_s=10.0)
+                return
+            if tag and tag.startswith("step-"):
+                # /stats "step" tracks the weights actually serving
+                try:
+                    info["step"] = int(tag[5:])
+                except ValueError:
+                    pass
+            self._reply(200, result)
 
     httpd = ThreadingHTTPServer((host, port), Handler)
     # answered-before-closed: server_close waits for handler threads, so
     # every accepted request gets its JSON reply before the process exits
     httpd.daemon_threads = False
     httpd.block_on_close = True
-    sup.start()
+    router.start()
     return ServerHandle(httpd=httpd, scheduler=sched, supervisor=sup,
-                        metrics=metrics, engine_factory=engine_factory,
-                        info=info)
+                        metrics=metrics,
+                        engine_factory=rep0.engine_factory,
+                        info=info, router=router)
 
 
 def main(argv=None) -> int:
@@ -410,8 +553,7 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", "cpu")
 
     from ..utils.checkpoint import CheckpointNotFoundError
-    from ..utils.resilience import dump_thread_stacks
-    from .load import load_for_serving
+    from .load import CheckpointWatcher, load_for_serving
 
     try:
         params, cfg, info = load_for_serving(
@@ -424,6 +566,21 @@ def main(argv=None) -> int:
           f"({info['num_nodes']}-node average) from {args.ckpt}",
           flush=True)
 
+    def reload_source(body):
+        """POST /reload + the checkpoint watcher: re-read the run dir
+        (newest valid step unless pinned) and hand back the node-
+        averaged params with a ``step-N`` weights tag. The architecture
+        must match — the fleet's compiled programs are config-keyed."""
+        ckpt = body.get("ckpt") or args.ckpt
+        new_params, new_cfg, new_info = load_for_serving(
+            ckpt, step=body.get("step"), config_path=args.config)
+        if new_cfg != cfg:
+            raise ValueError(
+                f"checkpoint {ckpt} carries a different model config — "
+                f"a hot-swap cannot change architecture; restart the "
+                f"server")
+        return new_params, f"step-{new_info['step']}"
+
     stop = threading.Event()
     handle = create_server(
         params, cfg, host=args.host, port=args.port,
@@ -434,9 +591,29 @@ def main(argv=None) -> int:
         max_restarts=getattr(args, "max_restarts"),
         metrics_dir=args.metrics_dir or os.path.join(args.ckpt, "serve"),
         info=info, stop_event=stop, page_size=args.page_size,
-        kv_pages=args.kv_pages, spec_tokens=args.spec_tokens)
-    httpd, sched, sup, metrics = (handle.httpd, handle.scheduler,
-                                  handle.supervisor, handle.metrics)
+        kv_pages=args.kv_pages, spec_tokens=args.spec_tokens,
+        replicas=args.replicas,
+        failover_retries=getattr(args, "failover_retries"),
+        reload_source=reload_source)
+    httpd, metrics, router = handle.httpd, handle.metrics, handle.router
+
+    watcher = None
+    if getattr(args, "reload_watch") > 0:
+
+        def on_new_step(step):
+            new_params, tag = reload_source({"step": step})
+            res = router.reload(new_params, weights_tag=tag)
+            # /stats "step" tracks the live weights — mutate the
+            # handler's copy (create_server dict()s the info it is given)
+            handle.info["step"] = step
+            print(f"gym_tpu.serve: checkpoint watcher — hot-swapped "
+                  f"{tag} into replicas {res['swapped']} "
+                  f"in {res['wall_s']}s", flush=True)
+
+        watcher = CheckpointWatcher(
+            args.ckpt, on_new_step,
+            poll_s=getattr(args, "reload_watch"),
+            initial_step=info["step"]).start()
 
     def graceful(signum):
         name = signal.Signals(signum).name
@@ -444,25 +621,16 @@ def main(argv=None) -> int:
               f"(answer in-flight, fail queued)", flush=True)
         deadline = getattr(args, "drain_deadline")
         stop.set()
-        if not sup.stop(join_timeout_s=deadline):
-            # the driver never came back within the drain deadline (a
-            # wedged dispatch, not a slow one): do NOT touch the engine
-            # from this thread — it is single-driver by contract and a
-            # concurrent step() would re-dispatch donated buffers. Dump
-            # the evidence and close the listener; in-flight requests
-            # stay unanswered, which is the truth of a wedged engine.
-            print(dump_thread_stacks(
-                "gym_tpu.serve: driver loop wedged past the "
-                f"{deadline:.0f}s drain deadline:"),
-                file=sys.stderr, flush=True)
-            # still fail queued + in-flight typed (flag writes only, no
-            # engine stepping) so blocked handlers get their answer and
-            # block_on_close can finish
-            sched.shutdown(finish_running=False, deadline_s=0.0)
-        else:
-            # shutdown() steps the engine itself until running slots
-            # finish — safe now that the driver thread has exited
-            sched.shutdown(finish_running=True, deadline_s=deadline)
+        if watcher is not None:
+            watcher.stop()
+        # per-replica drain: answer in-flight, fail queued typed; a
+        # WEDGED replica gets its thread stacks dumped and its requests
+        # failed typed without its engine ever being stepped from this
+        # thread (single-driver contract) — Router.close does both
+        if not router.close(drain_deadline_s=deadline):
+            print("gym_tpu.serve: one or more replica drivers wedged "
+                  "through the drain (stacks dumped above)",
+                  file=sys.stderr, flush=True)
         httpd.shutdown()
 
     def _on_signal(signum, frame):
@@ -481,20 +649,26 @@ def main(argv=None) -> int:
           + (f", spec {eng.spec_tokens}" if eng.spec_tokens else "")
           if eng.paged else "unpaged kv")
     print(f"gym_tpu.serve: listening on http://{args.host}:{handle.port} "
-          f"({args.num_slots} slots, queue {args.max_queue}, {kv}, "
+          f"({args.replicas} replica(s) x {args.num_slots} slots, "
+          f"queue {args.max_queue}, {kv}, "
           f"watchdog {getattr(args, 'dispatch_timeout'):.0f}s)", flush=True)
     try:
         httpd.serve_forever()
     finally:
         httpd.server_close()
+        if watcher is not None:
+            watcher.stop()
         metrics.sync()
         head = metrics.headline()
+        fleet = router.status()
         print(f"gym_tpu.serve: shut down cleanly — "
               f"{head['requests_done']} done, "
               f"{head['requests_failed']} failed "
               f"({head['requests_shed']} shed, "
               f"{head['requests_quarantined']} quarantined), "
               f"{head['engine_restarts']} engine restart(s), "
+              f"{fleet['failovers']} failover(s), "
+              f"{fleet['weight_reloads']} weight reload(s), "
               f"tokens_per_s={head['tokens_per_s']}", flush=True)
         metrics.close()
     return 0

@@ -618,7 +618,8 @@ class InferenceEngine:
     def __init__(self, params: PyTree, config: GPTConfig,
                  num_slots: int = 8, decode_chunk: int = 1,
                  paged: bool = False, page_size: int = 16,
-                 kv_pages: Optional[int] = None, spec_tokens: int = 0):
+                 kv_pages: Optional[int] = None, spec_tokens: int = 0,
+                 weights_tag: Optional[str] = None):
         """``decode_chunk``: decode steps fused into one dispatch (a
         device-side scan with on-device EOS/max-token bookkeeping).
         1 = purest continuous batching — admission/eviction can happen
@@ -637,7 +638,12 @@ class InferenceEngine:
         speculative decoding: each decode iteration drafts γ tokens by
         n-gram lookup and verifies them in one batched model call —
         token streams stay EXACTLY equal to the non-speculative engine
-        (see ``_spec_decode_program``)."""
+        (see ``_spec_decode_program``).
+
+        ``weights_tag`` names the parameter set this engine serves (e.g.
+        ``"step-120"``) — pure observability for the fleet router's
+        zero-downtime weight hot-swap: after a rolling reload, ``/stats``
+        proves which checkpoint each replica is generating from."""
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         if decode_chunk < 1:
@@ -653,6 +659,7 @@ class InferenceEngine:
                 "write blocks)")
         self.paged = bool(paged)
         self.spec_tokens = int(spec_tokens)
+        self.weights_tag = weights_tag
         base_cfg = decode_config(config)
         self.block_size = int(config.block_size)
         self.num_slots = int(num_slots)

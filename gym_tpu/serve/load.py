@@ -25,7 +25,10 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Any, Dict, Optional, Tuple
+import sys
+import threading
+import traceback
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -103,3 +106,76 @@ def load_for_serving(run_dir: str, step: Optional[int] = None,
     )(node_params)
     info = {"step": at_step, "num_nodes": k, "run_config": raw}
     return avg, config, info
+
+
+# -- checkpoint-dir watching (fleet weight hot-swap) ----------------------
+
+
+def latest_checkpoint_step(run_dir: str) -> Optional[int]:
+    """Newest COMMITTED checkpoint step in a run dir, from directory
+    names alone — cheap enough to poll. Orbax writes into a
+    tmp-suffixed dir and renames on commit, and quarantined dirs carry
+    a ``.corrupt-k`` suffix, so "committed" is exactly "the name is a
+    bare integer". None when the dir is missing/empty (a trainer that
+    has not checkpointed yet is not an error for a watcher)."""
+    try:
+        names = os.listdir(run_dir)
+    except OSError:
+        return None
+    steps = [int(n) for n in names if n.isdigit()]
+    return max(steps) if steps else None
+
+
+class CheckpointWatcher:
+    """Poll a trainer's run dir and fire ``on_new_step(step)`` whenever
+    a NEWER committed checkpoint appears — the push half of the fleet's
+    zero-downtime weight hot-swap (``python -m gym_tpu.serve
+    --reload-watch S`` wires the callback to a rolling
+    ``Router.reload``). Callback failures are logged, not fatal: a
+    single unreadable checkpoint must not kill the watcher — the
+    trainer's NEXT checkpoint gets its own attempt."""
+
+    def __init__(self, run_dir: str,
+                 on_new_step: Callable[[int], None],
+                 poll_s: float = 10.0,
+                 initial_step: Optional[int] = None):
+        """``initial_step``: the step already being served — only
+        strictly newer checkpoints fire (None = the first committed
+        checkpoint seen fires)."""
+        self.run_dir = run_dir
+        self.on_new_step = on_new_step
+        self.poll_s = float(poll_s)
+        self.last_step = initial_step
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="gym-tpu-serve-ckpt-watcher",
+            daemon=True)
+
+    def start(self) -> "CheckpointWatcher":
+        self._thread.start()
+        return self
+
+    def stop(self, join_timeout_s: float = 5.0) -> None:
+        self._stop.set()
+        self._thread.join(timeout=join_timeout_s)
+
+    def poll_once(self) -> Optional[int]:
+        """One poll (also the testable unit): fire the callback iff a
+        newer step committed; returns the step fired, else None."""
+        step = latest_checkpoint_step(self.run_dir)
+        if step is None or (self.last_step is not None
+                            and step <= self.last_step):
+            return None
+        self.last_step = step
+        try:
+            self.on_new_step(step)
+        except Exception:  # noqa: BLE001 — a failed reload must not
+            # kill the watcher; the next checkpoint retries
+            sys.stderr.write(
+                f"gym_tpu.serve: checkpoint watcher — on_new_step"
+                f"({step}) raised:\n{traceback.format_exc()}")
+        return step
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            self.poll_once()

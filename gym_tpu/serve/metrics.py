@@ -16,7 +16,25 @@ kinds share the header:
   observables ``kv_blocks_in_use`` / ``prefix_hit_blocks`` /
   ``spec_accept_rate`` (blank-or-zero on unpaged engines and absent in
   pre-paging CSVs). ``status=restart`` marks a supervisor engine
-  rebuild.
+  rebuild; ``status=reload`` a rolling weight hot-swap.
+
+Fleet serving (``serve/router.py``) shares ONE collector across N
+replicas: each replica's scheduler and supervisor write through a
+``replica_view(replica_id)`` facade, which stamps the new
+``replica_id`` column (blank on single-engine CSVs; ``read_headline``
+tolerates its absence, like the PR-7 schema bump) and maintains a
+PER-REPLICA tokens/s EWMA — the fleet's interleaved engine ticks would
+otherwise difference two different engines' token counters and produce
+garbage rates. Per-replica admission control reads its own replica's
+EWMA; ``headline()`` reports the fleet aggregate plus a ``replicas``
+section.
+
+Fleet counters are per-ATTEMPT, not per-client-request: a transparently
+failed-over request shows up as one ``failed`` attempt on the dead
+replica plus one ``done`` attempt on the sibling (the client saw a
+single 200). Alert on the router's ``retries_exhausted`` — the count of
+engine-death failures that actually REACHED a client — and reconcile
+``requests_failed`` against ``failovers``, both in ``/stats``.
 
 Beyond the counters, the collector maintains a tokens/s EWMA over driver
 ticks — the live service-rate estimate ``Scheduler.submit`` uses for
@@ -49,6 +67,9 @@ HEADER = [
     # paged-KV / speculative observables (engine rows; blank on request
     # rows and absent in pre-paging CSVs — read_headline tolerates both)
     "kv_blocks_in_use", "prefix_hit_blocks", "spec_accept_rate",
+    # fleet serving: which replica produced the row (blank on
+    # single-engine collectors and absent in pre-fleet CSVs)
+    "replica_id",
 ]
 
 #: EWMA smoothing for the live tokens/s estimate (per driver tick with
@@ -87,6 +108,126 @@ _STATUS_BY_EXC = {
 }
 
 
+class _RateState:
+    """One engine's tokens/s EWMA state — per replica in a fleet (the
+    interleaved ticks of two engines must never be differenced against
+    each other) plus the legacy single-engine slot. Caller holds the
+    collector's lock."""
+
+    __slots__ = ("ewma", "last_tok", "last_t", "idle_since")
+
+    def __init__(self):
+        self.ewma: Optional[float] = None
+        self.last_tok = 0
+        self.last_t: Optional[float] = None
+        self.idle_since: Optional[float] = None
+
+    def update(self, tok: int, now: float, active_slots: int,
+               queue_depth: int, idle_reset_s: float) -> None:
+        if self.last_t is not None:
+            d_tok = tok - self.last_tok
+            d_t = now - self.last_t
+            # d_tok < 0 = the engine was rebuilt/hot-swapped (counter
+            # reset): re-anchor, keep the old EWMA — the rate estimate
+            # survives a supervisor failover or a weight reload
+            if d_tok > 0 and d_t > 0:
+                inst = d_tok / d_t
+                self.ewma = (inst if self.ewma is None else
+                             EWMA_ALPHA * inst
+                             + (1.0 - EWMA_ALPHA) * self.ewma)
+                self.idle_since = None
+            elif int(active_slots) == 0 and queue_depth == 0:
+                # fully idle: after a while the old rate says nothing
+                # about the next request — go cold (optimistic admit)
+                # rather than reject on a stale-low estimate. A
+                # BUSY-but-stalled engine keeps its honest low rate.
+                if self.idle_since is None:
+                    self.idle_since = now
+                elif (now - self.idle_since >= idle_reset_s
+                      and self.ewma is not None):
+                    self.ewma = None
+            else:
+                self.idle_since = None
+        self.last_tok, self.last_t = tok, now
+
+
+class _ReplicaAgg:
+    """Per-replica slice of the fleet counters (the ``replicas`` section
+    of ``headline()``). Caller holds the collector's lock."""
+
+    __slots__ = ("rate", "done", "failed", "shed", "quarantined",
+                 "rejected", "restarts", "reloads", "tokens_out",
+                 "kv_blocks_in_use", "prefix_hit_blocks",
+                 "spec_accept_rate")
+
+    def __init__(self):
+        self.rate = _RateState()
+        self.done = self.failed = self.shed = 0
+        self.quarantined = self.rejected = 0
+        self.restarts = self.reloads = 0
+        self.tokens_out = 0
+        self.kv_blocks_in_use = 0
+        self.prefix_hit_blocks = 0
+        self.spec_accept_rate: Optional[float] = None
+
+    def headline(self) -> Dict[str, Any]:
+        return {
+            "requests_done": self.done,
+            "requests_failed": self.failed,
+            "requests_shed": self.shed,
+            "requests_quarantined": self.quarantined,
+            "requests_rejected": self.rejected,
+            "engine_restarts": self.restarts,
+            "engine_reloads": self.reloads,
+            "tokens_out": self.tokens_out,
+            "tokens_per_s_ewma": (round(self.rate.ewma, 2)
+                                  if self.rate.ewma is not None else None),
+            "kv_blocks_in_use": self.kv_blocks_in_use,
+            "prefix_hit_blocks": self.prefix_hit_blocks,
+        }
+
+
+class ReplicaMetrics:
+    """Replica-scoped facade over a shared ``ServeMetrics``: the exact
+    collector interface a ``Scheduler``/``Supervisor`` consumes, with
+    the replica id stamped on every write and the EWMA read scoped to
+    this replica (admission control must price a replica's OWN backlog
+    against its OWN service rate)."""
+
+    def __init__(self, base: "ServeMetrics", replica_id: int):
+        self.base = base
+        self.replica_id = int(replica_id)
+
+    def request_done(self, req, queue_depth: int,
+                     active_slots: int) -> None:
+        self.base.request_done(req, queue_depth, active_slots,
+                               replica_id=self.replica_id)
+
+    def request_rejected(self, queue_depth: int,
+                         active_slots: int) -> None:
+        self.base.request_rejected(queue_depth, active_slots,
+                                   replica_id=self.replica_id)
+
+    def engine_tick(self, stats, queue_depth: int) -> None:
+        self.base.engine_tick(stats, queue_depth,
+                              replica_id=self.replica_id)
+
+    def engine_restarted(self) -> None:
+        self.base.engine_restarted(replica_id=self.replica_id)
+
+    def engine_reloaded(self) -> None:
+        self.base.engine_reloaded(replica_id=self.replica_id)
+
+    def tokens_per_s_ewma(self) -> Optional[float]:
+        return self.base.tokens_per_s_ewma(replica_id=self.replica_id)
+
+    def headline(self) -> Dict[str, Any]:
+        return self.base.headline()
+
+    def sync(self) -> None:
+        self.base.sync()
+
+
 class ServeMetrics:
     def __init__(self, out_dir: str, engine_log_every: int = 50,
                  ewma_idle_reset_s: float = EWMA_IDLE_RESET_S):
@@ -111,6 +252,7 @@ class ServeMetrics:
         self.requests_quarantined = 0
         self.requests_rejected = 0
         self.engine_restarts = 0
+        self.engine_reloads = 0
         self.tokens_out = 0
         self._ttft_sum = 0.0
         self._ttft_n = 0
@@ -118,11 +260,9 @@ class ServeMetrics:
         self._lat_n = 0
         self._ttfts: deque = deque(maxlen=PERCENTILE_WINDOW)
         self._lats: deque = deque(maxlen=PERCENTILE_WINDOW)
-        self._ewma: Optional[float] = None
-        self._ewma_last_tok = 0
-        self._ewma_last_t: Optional[float] = None
+        self._rate = _RateState()       # legacy single-engine EWMA slot
+        self._replicas: Dict[int, _ReplicaAgg] = {}
         self._ewma_idle_reset_s = float(ewma_idle_reset_s)
-        self._idle_since: Optional[float] = None
         # last engine sample of the paged/speculative observables (an
         # unpaged engine reports 0 blocks and a None accept rate)
         self._kv_blocks_in_use = 0
@@ -132,8 +272,24 @@ class ServeMetrics:
     def _now(self) -> float:
         return time.perf_counter() - self._t0
 
-    def request_done(self, req, queue_depth: int,
-                     active_slots: int) -> None:
+    def replica_view(self, replica_id: int) -> ReplicaMetrics:
+        """Replica-scoped facade for one fleet member's scheduler and
+        supervisor (see ``ReplicaMetrics``)."""
+        with self._lock:
+            self._replicas.setdefault(int(replica_id), _ReplicaAgg())
+        return ReplicaMetrics(self, replica_id)
+
+    def _rep(self, replica_id: Optional[int]) -> Optional[_ReplicaAgg]:
+        if replica_id is None:
+            return None
+        return self._replicas.setdefault(int(replica_id), _ReplicaAgg())
+
+    @staticmethod
+    def _rid_cell(replica_id: Optional[int]):
+        return "" if replica_id is None else int(replica_id)
+
+    def request_done(self, req, queue_depth: int, active_slots: int,
+                     replica_id: Optional[int] = None) -> None:
         with self._lock:
             if self._f.closed:        # straggler after close(): drop it
                 return
@@ -147,6 +303,13 @@ class ServeMetrics:
             self.requests_shed += int(status == "shed")
             self.requests_quarantined += int(status == "quarantined")
             self.tokens_out += len(req.tokens)
+            rep = self._rep(replica_id)
+            if rep is not None:
+                rep.failed += int(failed)
+                rep.done += int(not failed)
+                rep.shed += int(status == "shed")
+                rep.quarantined += int(status == "quarantined")
+                rep.tokens_out += len(req.tokens)
             ttft = req.ttft_s
             lat = req.avg_token_latency_s
             if ttft is not None:
@@ -164,40 +327,66 @@ class ServeMetrics:
                 "" if ttft is None else f"{ttft:.5f}",
                 "" if lat is None else f"{lat:.5f}",
                 self.tokens_out, f"{self.tokens_per_s():.2f}",
-                "", "", "",
+                "", "", "", self._rid_cell(replica_id),
             ])
             self._f.flush()
 
-    def request_rejected(self, queue_depth: int,
-                         active_slots: int) -> None:
+    def request_rejected(self, queue_depth: int, active_slots: int,
+                         replica_id: Optional[int] = None) -> None:
         """Admission control shed a request before it was enqueued (no
         Request object ever existed — the whole point)."""
         with self._lock:
             if self._f.closed:
                 return
             self.requests_rejected += 1
+            rep = self._rep(replica_id)
+            if rep is not None:
+                rep.rejected += 1
             self._w.writerow([
                 f"{self._now():.4f}", "request", "", "rejected",
                 queue_depth, active_slots, "", "", "", "",
                 self.tokens_out, f"{self.tokens_per_s():.2f}",
-                "", "", "",
+                "", "", "", self._rid_cell(replica_id),
             ])
             self._f.flush()
 
-    def engine_restarted(self) -> None:
+    def engine_restarted(self, replica_id: Optional[int] = None) -> None:
         """A supervisor failover rebuilt the engine."""
         with self._lock:
             if self._f.closed:
                 return
             self.engine_restarts += 1
+            rep = self._rep(replica_id)
+            if rep is not None:
+                rep.restarts += 1
             self._w.writerow([
                 f"{self._now():.4f}", "engine", "", "restart", "", "",
                 "", "", "", "", self.tokens_out,
                 f"{self.tokens_per_s():.2f}", "", "", "",
+                self._rid_cell(replica_id),
             ])
             self._f.flush()
 
-    def engine_tick(self, stats, queue_depth: int) -> None:
+    def engine_reloaded(self, replica_id: Optional[int] = None) -> None:
+        """A rolling weight hot-swap replaced this engine's params (the
+        router drained the replica first — no restart, no failures)."""
+        with self._lock:
+            if self._f.closed:
+                return
+            self.engine_reloads += 1
+            rep = self._rep(replica_id)
+            if rep is not None:
+                rep.reloads += 1
+            self._w.writerow([
+                f"{self._now():.4f}", "engine", "", "reload", "", "",
+                "", "", "", "", self.tokens_out,
+                f"{self.tokens_per_s():.2f}", "", "", "",
+                self._rid_cell(replica_id),
+            ])
+            self._f.flush()
+
+    def engine_tick(self, stats, queue_depth: int,
+                    replica_id: Optional[int] = None) -> None:
         """Per-driver-round sample. ALWAYS updates the tokens/s EWMA
         (admission control reads it live); writes a CSV row only every
         ``engine_log_every``-th call so an idle server doesn't grow the
@@ -209,38 +398,22 @@ class ServeMetrics:
                 return
             now = self._now()
             tok = int(stats.tokens_generated)
-            if self._ewma_last_t is not None:
-                d_tok = tok - self._ewma_last_tok
-                d_t = now - self._ewma_last_t
-                # d_tok < 0 = the engine was rebuilt (counter reset):
-                # re-anchor, keep the old EWMA — the rate estimate
-                # survives a supervisor failover
-                if d_tok > 0 and d_t > 0:
-                    inst = d_tok / d_t
-                    self._ewma = (inst if self._ewma is None else
-                                  EWMA_ALPHA * inst
-                                  + (1.0 - EWMA_ALPHA) * self._ewma)
-                    self._idle_since = None
-                elif int(stats.active_slots) == 0 and queue_depth == 0:
-                    # fully idle: after a while the old rate says nothing
-                    # about the next request — go cold (optimistic admit)
-                    # rather than reject on a stale-low estimate. A
-                    # BUSY-but-stalled engine keeps its honest low rate.
-                    if self._idle_since is None:
-                        self._idle_since = now
-                    elif (now - self._idle_since >= self._ewma_idle_reset_s
-                          and self._ewma is not None):
-                        self._ewma = None
-                else:
-                    self._idle_since = None
-            self._ewma_last_tok, self._ewma_last_t = tok, now
-            self._kv_blocks_in_use = int(
-                getattr(stats, "kv_blocks_in_use", 0))
-            self._prefix_hit_blocks = int(
-                getattr(stats, "prefix_hit_blocks", 0))
+            rep = self._rep(replica_id)
+            rate = self._rate if rep is None else rep.rate
+            rate.update(tok, now, int(stats.active_slots), queue_depth,
+                        self._ewma_idle_reset_s)
+            kv = int(getattr(stats, "kv_blocks_in_use", 0))
+            ph = int(getattr(stats, "prefix_hit_blocks", 0))
             rate_fn = getattr(stats, "spec_accept_rate", None)
-            self._spec_accept_rate = rate_fn() if callable(rate_fn) \
-                else None
+            sr = rate_fn() if callable(rate_fn) else None
+            if rep is None:
+                self._kv_blocks_in_use = kv
+                self._prefix_hit_blocks = ph
+                self._spec_accept_rate = sr
+            else:
+                rep.kv_blocks_in_use = kv
+                rep.prefix_hit_blocks = ph
+                rep.spec_accept_rate = sr
             self._ticks += 1
             if self._ticks % self._every:
                 return
@@ -248,23 +421,53 @@ class ServeMetrics:
                 f"{now:.4f}", "engine", "", "", queue_depth,
                 stats.active_slots, "", "", "", "",
                 stats.tokens_generated, f"{self.tokens_per_s():.2f}",
-                self._kv_blocks_in_use, self._prefix_hit_blocks,
-                ("" if self._spec_accept_rate is None
-                 else f"{self._spec_accept_rate:.4f}"),
+                kv, ph, ("" if sr is None else f"{sr:.4f}"),
+                self._rid_cell(replica_id),
             ])
 
     def tokens_per_s(self) -> float:
         dt = self._now()
         return self.tokens_out / dt if dt > 0 else 0.0
 
-    def tokens_per_s_ewma(self) -> Optional[float]:
+    def tokens_per_s_ewma(self, replica_id: Optional[int] = None
+                          ) -> Optional[float]:
         """Live service-rate estimate (None until the first productive
-        tick) — the admission-control input."""
+        tick) — the admission-control input. ``replica_id`` scopes the
+        read to one fleet member; without it, a fleet collector reports
+        the AGGREGATE rate (sum of live per-replica EWMAs) and a
+        single-engine collector its own."""
         with self._lock:
-            return self._ewma
+            if replica_id is not None:
+                rep = self._replicas.get(int(replica_id))
+                return rep.rate.ewma if rep is not None else None
+            if self._replicas:
+                live = [r.rate.ewma for r in self._replicas.values()
+                        if r.rate.ewma is not None]
+                return sum(live) if live else None
+            return self._rate.ewma
 
     def headline(self) -> Dict[str, Any]:
         with self._lock:
+            if self._replicas:
+                # fleet aggregates: per-replica samples summed; rates
+                # summed over live EWMAs; spec rate averaged over
+                # replicas that have one
+                ewmas = [r.rate.ewma for r in self._replicas.values()
+                         if r.rate.ewma is not None]
+                ewma = sum(ewmas) if ewmas else None
+                kv = sum(r.kv_blocks_in_use
+                         for r in self._replicas.values())
+                ph = sum(r.prefix_hit_blocks
+                         for r in self._replicas.values())
+                srs = [r.spec_accept_rate
+                       for r in self._replicas.values()
+                       if r.spec_accept_rate is not None]
+                sr = sum(srs) / len(srs) if srs else None
+            else:
+                ewma = self._rate.ewma
+                kv = self._kv_blocks_in_use
+                ph = self._prefix_hit_blocks
+                sr = self._spec_accept_rate
             head = {
                 "requests_done": self.requests_done,
                 "requests_failed": self.requests_failed,
@@ -272,22 +475,26 @@ class ServeMetrics:
                 "requests_quarantined": self.requests_quarantined,
                 "requests_rejected": self.requests_rejected,
                 "engine_restarts": self.engine_restarts,
+                "engine_reloads": self.engine_reloads,
                 "tokens_out": self.tokens_out,
                 "wall_s": round(self._now(), 3),
                 "tokens_per_s": round(self.tokens_per_s(), 2),
-                "tokens_per_s_ewma": (round(self._ewma, 2)
-                                      if self._ewma is not None else None),
+                "tokens_per_s_ewma": (round(ewma, 2)
+                                      if ewma is not None else None),
                 "mean_ttft_s": (round(self._ttft_sum / self._ttft_n, 5)
                                 if self._ttft_n else None),
                 "mean_token_latency_s": (
                     round(self._lat_sum / self._lat_n, 5)
                     if self._lat_n else None),
-                "kv_blocks_in_use": self._kv_blocks_in_use,
-                "prefix_hit_blocks": self._prefix_hit_blocks,
+                "kv_blocks_in_use": kv,
+                "prefix_hit_blocks": ph,
                 "spec_accept_rate": (
-                    round(self._spec_accept_rate, 4)
-                    if self._spec_accept_rate is not None else None),
+                    round(sr, 4) if sr is not None else None),
             }
+            if self._replicas:
+                head["replicas"] = {
+                    str(rid): rep.headline()
+                    for rid, rep in sorted(self._replicas.items())}
             head.update(_percentiles(self._ttfts, "ttft"))
             head.update(_percentiles(self._lats, "token_lat"))
             return head
@@ -325,20 +532,41 @@ def read_headline(path: str) -> Dict[str, Any]:
     the same counters and percentiles ``ServeMetrics.headline`` reports
     live, derived post-hoc from the request rows (so a finished run, a
     synthetic fixture, or another process's CSV all aggregate the same
-    way). Engine rows contribute only ``engine_restarts``."""
+    way). Engine rows contribute ``engine_restarts`` and
+    ``engine_reloads``. Fleet CSVs (rows carrying the ``replica_id``
+    column) additionally aggregate a per-replica ``replicas`` section;
+    pre-fleet CSVs (no such column, like pre-paging CSVs lack the KV
+    columns) produce the same fleet-free headline they always did."""
     counts = {"done": 0, "failed": 0, "shed": 0, "quarantined": 0,
               "rejected": 0}
-    restarts = 0
+    restarts = reloads = 0
     tokens_out = 0
     last_ts = 0.0
     ttfts: List[float] = []
     lats: List[float] = []
     kv_blocks, prefix_hits, spec_rate = 0, 0, None
+    per_rep: Dict[str, Dict[str, int]] = {}
+
+    def rep_of(row):
+        rid = row.get("replica_id")
+        if rid is None or rid == "":
+            return None
+        return per_rep.setdefault(str(int(rid)), {
+            "requests_done": 0, "requests_failed": 0,
+            "engine_restarts": 0, "engine_reloads": 0, "tokens_out": 0})
+
     with open(path, newline="") as f:
         for row in csv.DictReader(f):
             last_ts = max(last_ts, float(row["ts_s"] or 0.0))
             if row["kind"] == "engine":
                 restarts += int(row["status"] == "restart")
+                reloads += int(row["status"] == "reload")
+                rep = rep_of(row)
+                if rep is not None:
+                    rep["engine_restarts"] += int(
+                        row["status"] == "restart")
+                    rep["engine_reloads"] += int(
+                        row["status"] == "reload")
                 # paged/spec observables: last engine sample wins (the
                 # columns are absent in pre-paging CSVs)
                 if row.get("kv_blocks_in_use"):
@@ -354,6 +582,12 @@ def read_headline(path: str) -> Dict[str, Any]:
             if status in counts:
                 counts[status] += 1
             tokens_out += int(row["new_tokens"] or 0)
+            rep = rep_of(row)
+            if rep is not None:
+                rep["requests_done"] += int(status == "done")
+                rep["requests_failed"] += int(
+                    status in ("failed", "shed", "quarantined"))
+                rep["tokens_out"] += int(row["new_tokens"] or 0)
             if row["ttft_s"]:
                 ttfts.append(float(row["ttft_s"]))
             if row["avg_token_latency_s"]:
@@ -366,6 +600,7 @@ def read_headline(path: str) -> Dict[str, Any]:
         "requests_quarantined": counts["quarantined"],
         "requests_rejected": counts["rejected"],
         "engine_restarts": restarts,
+        "engine_reloads": reloads,
         "tokens_out": tokens_out,
         "wall_s": round(last_ts, 3),
         "tokens_per_s": round(tokens_out / last_ts, 2) if last_ts else 0.0,
@@ -377,6 +612,8 @@ def read_headline(path: str) -> Dict[str, Any]:
         "prefix_hit_blocks": prefix_hits,
         "spec_accept_rate": spec_rate,
     }
+    if per_rep:
+        head["replicas"] = dict(sorted(per_rep.items()))
     head.update(_percentiles(ttfts, "ttft"))
     head.update(_percentiles(lats, "token_lat"))
     return head

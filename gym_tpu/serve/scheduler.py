@@ -200,6 +200,10 @@ class Scheduler:
         self._accepting = True
         self._shutdown_done = False
         self._epoch = 0
+        # weight hot-swap support (serve/router.py): while paused, step()
+        # keeps decoding the running slots but admits nothing new, so a
+        # draining replica quiesces under sustained queued traffic
+        self._admission_paused = False
         # queued requests carrying a deadline — lets the per-step shed
         # sweep early-out to one integer check in the (common)
         # no-deadline deployment instead of an O(queue) scan
@@ -296,6 +300,42 @@ class Scheduler:
         with self._lock:
             return len(self._by_slot)
 
+    def inflight(self) -> int:
+        """Requests the engine currently holds state for: running slots
+        plus one mid-``admit``. Queued requests do NOT count — they carry
+        no engine state and survive an engine swap untouched. The
+        router's rolling weight reload waits for this to reach 0."""
+        with self._lock:
+            return (len(self._by_slot)
+                    + (1 if self._admitting is not None else 0))
+
+    def backlog_tokens(self) -> int:
+        """Committed future work in tokens (queued max_new + remaining of
+        running + mid-admission) — the router's least-loaded dispatch
+        score. Same accounting as ``_estimate_service_s``'s backlog."""
+        with self._lock:
+            t = sum(r.sampling.max_new_tokens for r in self._queue)
+            t += sum(max(0, r.sampling.max_new_tokens - len(r.tokens))
+                     for r in self._by_slot.values())
+            if self._admitting is not None:
+                t += self._admitting.sampling.max_new_tokens
+            return t
+
+    # -- admission pause (rolling weight hot-swap) ------------------------
+
+    def pause_admission(self) -> None:
+        """Stop admitting queued requests into slots (running slots keep
+        decoding to completion; submits still enqueue). The router pauses
+        a replica, waits for ``inflight() == 0``, swaps the engine, then
+        ``resume_admission`` — queued requests admit onto the NEW
+        engine, which is what makes the weight swap zero-downtime."""
+        with self._lock:
+            self._admission_paused = True
+
+    def resume_admission(self) -> None:
+        with self._lock:
+            self._admission_paused = False
+
     # -- driver side ------------------------------------------------------
 
     def _shed_expired_queued(self, now: float) -> List[Request]:
@@ -364,7 +404,13 @@ class Scheduler:
         admitted = 0
         while engine.free_slots():
             with self._drained:
-                if self._epoch != epoch or not self._queue:
+                # _admission_paused re-checked HERE, not just in step()'s
+                # snapshot: it shares this lock with pause_admission, so
+                # once the router has paused and observed inflight()==0,
+                # no driver iteration — however stale its snapshot — can
+                # still pop a request into the about-to-be-swapped engine
+                if (self._epoch != epoch or self._admission_paused
+                        or not self._queue):
                     break
                 idx = self._pick_admit_index(engine)
                 if idx is None:
@@ -459,7 +505,8 @@ class Scheduler:
         with self._lock:
             epoch = self._epoch
             engine = self.engine
-        produced = self._admit_from_queue(epoch, engine)
+            paused = self._admission_paused
+        produced = 0 if paused else self._admit_from_queue(epoch, engine)
         events = engine.step()
         now = time.perf_counter()
         completed: List[Request] = []
@@ -555,10 +602,16 @@ class Scheduler:
         return victims
 
     def replace_engine(self, engine: InferenceEngine) -> None:
-        """Swap in a rebuilt engine (after ``fail_inflight``). The global
-        program LRUs make the swap warm: same config → no recompiles."""
+        """Swap in a rebuilt engine (after ``fail_inflight``, or a
+        drained hot-swap). The global program LRUs make the swap warm:
+        same config → no recompiles. The epoch bump invalidates any
+        driver iteration that snapshotted the OLD engine before the
+        swap: without it, a preempted driver could still admit a queued
+        request into the detached engine (old weights, slots the new
+        engine never steps)."""
         with self._lock:
             self.engine = engine
+            self._epoch += 1
 
     def run(self, stop: threading.Event, idle_wait_s: float = 0.005):
         """Drive ``step`` until ``stop`` is set; sleeps briefly when idle

@@ -58,13 +58,20 @@ class Supervisor:
                  max_restarts: int = 5,
                  metrics=None,
                  idle_wait_s: float = 0.005,
+                 on_dead: Optional[Callable[[BaseException], None]] = None,
                  log=print):
+        """``on_dead(error)`` fires once, AFTER the supervisor declares
+        the engine unrecoverable (queued requests already failed typed,
+        ``failed`` set) — the fleet router hooks it to pull the replica
+        out of dispatch the moment it dies instead of on the next
+        health poll."""
         self.scheduler = scheduler
         self.engine_factory = engine_factory
         self.dispatch_timeout_s = float(dispatch_timeout_s)
         self.max_restarts = int(max_restarts)
         self.metrics = metrics
         self.idle_wait_s = float(idle_wait_s)
+        self.on_dead = on_dead
         self._log = log
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -196,6 +203,14 @@ class Supervisor:
         # set LAST: anyone who observes `failed` may rely on the
         # scheduler already refusing new work
         self.failed = error
+        if self.on_dead is not None:
+            try:
+                self.on_dead(error)
+            except Exception:  # noqa: BLE001 — a broken death observer
+                # must not mask the death itself
+                sys.stderr.write(
+                    f"gym_tpu.serve: supervisor on_dead callback "
+                    f"raised:\n{traceback.format_exc()}")
 
     # -- shutdown ---------------------------------------------------------
 

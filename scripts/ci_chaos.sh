@@ -15,7 +15,15 @@
 #      (503, inside its deadline — never a 500), rebuild the engine and
 #      answer the next request; an infeasible deadline must draw
 #      429 + Retry-After; SIGTERM must still exit 0 with a clean
-#      shutdown line.
+#      shutdown line;
+#   4. the REPLICA-KILL drill (ISSUE 8) against `--replicas 2
+#      --max-restarts 0`: a decode hang lands mid-stream on replica 0
+#      and the exhausted restart budget makes it a hard engine death —
+#      the client must STILL get its 200 (transparent failover to the
+#      sibling, full token stream), /stats must record failovers>=1
+#      with the dead replica excluded from dispatch, and SIGTERM must
+#      exit 0 while replica 0's driver is still wedged (per-replica
+#      stack dump, typed queued failures, no engine stepping).
 #
 # CPU-only; sized for the 2-core container.
 #
@@ -177,6 +185,120 @@ grep -q "engine restart" "$OUT/server.log" || {
     echo "ci_chaos: no restart count in shutdown line";
     cat "$OUT/server.log"; exit 1; }
 
+# Layer 4: replica-kill drill — same tiny checkpoint, 2 replicas, zero
+# restart budget. Request A (max_new 4: prefill + decode dispatches 1-3)
+# primes replica 0; request B (max_new 8) lands on replica 0 too (idle
+# tie-break) and wedges at decode dispatch 6 — MID-stream, ~3 tokens in.
+# The 15 s watchdog reaps the wedged driver, the exhausted budget
+# declares replica 0 dead, and the router must retry B on replica 1
+# under B's remaining deadline: the client sees 200 and the full 8
+# tokens, never the death. --drain-deadline is short because replica 0's
+# driver is STILL wedged at SIGTERM: close must dump its stacks and fail
+# its requests typed instead of waiting out the hang.
+PORT2=$((PORT + 1))
+env JAX_PLATFORMS=cpu PYTHONPATH="$REPO" \
+    GYM_TPU_FAULTS="serve.decode:hang=600@6" \
+    python -m gym_tpu.serve \
+    --ckpt "$OUT/ckpts/ci" --port "$PORT2" --num_slots 2 --device cpu \
+    --replicas 2 --max-restarts 0 --dispatch-timeout 15 \
+    --drain-deadline 5 \
+    > "$OUT/fleet.log" 2>&1 &
+SRV=$!
+for _ in $(seq 1 90); do
+    grep -q "listening" "$OUT/fleet.log" && break
+    kill -0 "$SRV" 2>/dev/null || { echo "ci_chaos: fleet server died at startup";
+        cat "$OUT/fleet.log"; exit 1; }
+    sleep 1
+done
+grep -q "listening" "$OUT/fleet.log" || {
+    echo "ci_chaos: fleet server never started"; kill -9 "$SRV"; exit 1; }
+
+timeout -k 10 240 env GYM_TPU_CI_CHAOS_PORT="$PORT2" python - <<'EOF'
+import json, os, time, urllib.error, urllib.request
+
+port = os.environ["GYM_TPU_CI_CHAOS_PORT"]
+
+def post(payload, timeout=120):
+    body = json.dumps(payload).encode()
+    t0 = time.perf_counter()
+    try:
+        r = urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate", body,
+            {"Content-Type": "application/json"}), timeout=timeout)
+        return r.status, json.loads(r.read()), time.perf_counter() - t0
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), time.perf_counter() - t0
+
+# A: decode dispatches 1-3 on replica 0 — completes, primes programs
+code, body, _ = post({"prompt": [1, 2, 3], "max_new_tokens": 4,
+                      "top_k": 4, "seed": 0, "deadline_s": 90})
+assert code == 200 and len(body["tokens"]) == 4, (code, body)
+assert body["replica"] == 0 and body["failovers"] == 0, body
+print("ci_chaos: fleet pre-kill request ok on replica", body["replica"])
+
+# B: wedges replica 0 at dispatch 6, mid-stream; restart budget 0 makes
+# it a hard death — the router must answer via replica 1: 200, full
+# stream, inside B's deadline
+code, body, dt = post({"prompt": [1, 2, 3], "max_new_tokens": 8,
+                       "top_k": 4, "seed": 1, "deadline_s": 60})
+assert code == 200, (code, body)
+assert len(body["tokens"]) == 8, body
+assert body["replica"] == 1, body
+assert body["failovers"] >= 1, body
+assert dt < 60, f"failover took {dt:.1f}s — past B's deadline"
+print(f"ci_chaos: replica-kill survived — 200 via replica 1 in "
+      f"{dt:.1f}s ({body['failovers']} failover)")
+
+# C: the dead replica is OUT of dispatch — every subsequent request
+# lands on the sibling
+for seed in (2, 3):
+    code, body, _ = post({"prompt": [1, 2, 3], "max_new_tokens": 4,
+                          "top_k": 4, "seed": seed, "deadline_s": 90})
+    assert code == 200 and body["replica"] == 1, (code, body)
+print("ci_chaos: dead replica excluded from dispatch")
+
+stats = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/stats", timeout=30).read())
+assert stats["failovers"] >= 1, stats
+assert stats["healthy_replicas"] == 1, stats
+reps = {r["id"]: r for r in stats["replicas"]}
+assert reps[0]["dead"] is True and reps[0]["healthy"] is False, stats
+assert reps[1]["healthy"] is True, stats
+assert stats["status"] == "degraded", stats
+print("ci_chaos: fleet stats ok —", json.dumps({
+    "failovers": stats["failovers"],
+    "healthy_replicas": stats["healthy_replicas"],
+    "retries_exhausted": stats["retries_exhausted"]}))
+EOF
+rc=$?
+[ "$rc" -ne 0 ] && { echo "ci_chaos: replica-kill drill failed";
+    cat "$OUT/fleet.log"; kill -9 "$SRV"; exit "$rc"; }
+
+grep -q "failover: request retried on replica 1" "$OUT/fleet.log" || {
+    echo "ci_chaos: no failover line in fleet log";
+    cat "$OUT/fleet.log"; exit 1; }
+grep -q "replica 0 declared dead" "$OUT/fleet.log" || {
+    echo "ci_chaos: no replica-death line in fleet log";
+    cat "$OUT/fleet.log"; exit 1; }
+
+# SIGTERM with replica 0's driver still wedged in the 600 s hang: the
+# close must dump that replica's stacks, fail its requests typed and
+# STILL exit 0 with the clean-shutdown headline (failovers included)
+kill -TERM "$SRV"
+wait "$SRV"; rc=$?
+[ "$rc" -ne 0 ] && { echo "ci_chaos: fleet exit rc=$rc after SIGTERM";
+    cat "$OUT/fleet.log"; exit 1; }
+grep -q "replica 0 driver wedged" "$OUT/fleet.log" || {
+    echo "ci_chaos: no per-replica wedge stack dump";
+    cat "$OUT/fleet.log"; exit 1; }
+grep -q "shut down cleanly" "$OUT/fleet.log" || {
+    echo "ci_chaos: no clean-shutdown line in fleet log";
+    cat "$OUT/fleet.log"; exit 1; }
+grep -q "failover(s)" "$OUT/fleet.log" || {
+    echo "ci_chaos: no failover count in shutdown line";
+    cat "$OUT/fleet.log"; exit 1; }
+echo "ci_chaos: replica-kill drill OK (log at $OUT/fleet.log)"
+
 # bench rider: one-line shed/recovered/percentile headline
 timeout -k 10 600 python "$REPO/bench.py" --chaos-only \
     > "$OUT/chaos_bench.json" 2> "$OUT/chaos_bench.err" || {
@@ -198,5 +320,30 @@ print("ci_chaos: bench headline ok —", json.dumps({
 EOF
 rc=$?
 [ "$rc" -ne 0 ] && exit "$rc"
-echo "ci_chaos: OK (log at $OUT/server.log)"
+
+# fleet bench rider (ISSUE 8): replica-kill + rolling hot-swap drills as
+# one JSON line — the BENCHMARKS "Fleet failover & hot-swap" numbers
+timeout -k 10 600 python "$REPO/bench.py" --fleet-only \
+    > "$OUT/fleet_bench.json" 2> "$OUT/fleet_bench.err" || {
+    echo "ci_chaos: bench.py --fleet-only failed";
+    cat "$OUT/fleet_bench.err"; exit 1; }
+python - "$OUT/fleet_bench.json" <<'EOF'
+import json, sys
+with open(sys.argv[1]) as f:
+    head = json.loads(f.read().strip().splitlines()[0])["fleet"]
+kill, swap = head["replica_kill"], head["hot_swap"]
+assert kill["requests_failed"] == 0, head
+assert kill["failovers"] >= 1 and kill["dead_replicas"] == 1, head
+assert swap["requests_failed"] == 0, head
+assert swap["recompiles_during_swap"] == 0, head
+assert swap["post_swap_params_verified"] is True, head
+print("ci_chaos: fleet bench ok —", json.dumps({
+    "kill_failovers": kill["failovers"],
+    "kill_requests_ok": kill["requests_ok"],
+    "swap_requests_ok": swap["requests_ok"],
+    "swap_reload_wall_s": swap["reload_wall_s"]}))
+EOF
+rc=$?
+[ "$rc" -ne 0 ] && exit "$rc"
+echo "ci_chaos: OK (logs at $OUT/server.log, $OUT/fleet.log)"
 exit 0
