@@ -33,13 +33,16 @@ recompile (same logical program, different jit options).
 from __future__ import annotations
 
 import dataclasses
-import hashlib
 import json
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
+# program_key moved to gym_tpu.programs.keys so the device-program
+# registry and this auditor compute THE SAME key from the same function
+# — re-exported here for existing importers
+from ..programs.keys import program_key  # noqa: F401  (re-export)
 from .jaxpr_tools import trace_with_axis_env, walk_jaxpr
 
 PyTree = Any
@@ -97,41 +100,6 @@ class ProgramAudit:
             "n_eqns": self.n_eqns, "n_collectives": self.n_collectives,
             "findings": [f.as_dict() for f in self.findings],
         }
-
-
-def _leaf_avals(tree: PyTree) -> List[Tuple[Tuple[int, ...], str]]:
-    out = []
-    for leaf in jax.tree.leaves(tree):
-        shape = tuple(getattr(leaf, "shape", ()))
-        dtype = str(np.dtype(getattr(leaf, "dtype", np.float32)))
-        out.append((shape, dtype))
-    return out
-
-
-def _jsonable_config(config: Dict[str, Any]) -> Dict[str, str]:
-    return {str(k): repr(v) for k, v in sorted(config.items())}
-
-
-def program_key(name: str, config: Dict[str, Any], args: Sequence[Any],
-                donate_args: Sequence[int],
-                out_avals: Optional[Sequence[Tuple]] = None
-                ) -> Tuple[str, str]:
-    """Canonical program key: ``(name × config × input shapes/dtypes ×
-    donation mask)`` as a deterministic JSON string plus its sha256[:16]
-    hash — the future device-program-registry key (ROADMAP item 5). Two
-    dispatches whose keys hash equal may share a compiled executable;
-    two programs with the same ``name``/``config`` but different keys
-    are a recompile."""
-    desc = {
-        "name": name,
-        "config": _jsonable_config(config),
-        "in_avals": [_leaf_avals(a) for a in args],
-        "donated": sorted(int(i) for i in donate_args),
-    }
-    if out_avals is not None:
-        desc["out_avals"] = list(out_avals)
-    canon = json.dumps(desc, sort_keys=True, separators=(",", ":"))
-    return canon, hashlib.sha256(canon.encode()).hexdigest()[:16]
 
 
 def _count_eqns(jaxpr) -> int:
@@ -292,162 +260,92 @@ def trainer_step_specs(num_nodes: int = 4, n_micro: int = 1,
     return specs
 
 
+def _spec_from_def(pdef) -> ProgramSpec:
+    """A registry ``ProgramDef`` as an auditable ``ProgramSpec`` — same
+    name/config/templates/donation, so ``program_key`` over the spec and
+    ``pdef.key()`` are the same key by construction."""
+    return ProgramSpec(name=pdef.name, fn=pdef.builder(), args=pdef.args,
+                       donate_args=pdef.donate_args, config=pdef.config,
+                       family=pdef.family)
+
+
+def engine_program_defs(num_slots: int = 2, decode_chunk: int = 4,
+                        buckets: Sequence[int] = (8, 32),
+                        page_size: int = 8, gamma: int = 4):
+    """Every serving-engine program at the audit parameterization, as
+    registry ``ProgramDef``s — enumerated through the device-program
+    registry's public definitions (``gym_tpu.programs.serve_defs``),
+    NOT private engine builders: the defs the auditor traces are the
+    defs the engine acquires, so the audit key set and the registry key
+    set cannot drift independently."""
+    import dataclasses as _dc
+
+    from ..models.nanogpt import decode_config
+    from ..programs import serve_defs as sd
+
+    cfg_tuple = _dc.astuple(decode_config(_tiny_gpt_config()))
+    defs = [sd.prefill_def(cfg_tuple, int(b)) for b in buckets]
+    defs.append(sd.slot_admit_def(cfg_tuple, num_slots))
+    defs.append(sd.slot_decode_def(cfg_tuple, num_slots, decode_chunk))
+    defs.extend(paged_program_defs(num_slots=num_slots,
+                                   decode_chunk=decode_chunk,
+                                   buckets=buckets, page_size=page_size,
+                                   gamma=gamma))
+    return defs
+
+
+def paged_program_defs(num_slots: int = 2, decode_chunk: int = 4,
+                       buckets: Sequence[int] = (8, 32),
+                       page_size: int = 8, gamma: int = 4):
+    """The paged-KV/speculative program family (ISSUE 7) as registry
+    ``ProgramDef``s: prefix-aware paged prefill (per bucket), the
+    copy-on-write page copy, the paged ``decode_chunk`` scan, and the
+    fused draft+verify speculative program. All four DONATE the
+    page-pool cache — it is the multi-MB buffer threaded linearly
+    through every dispatch."""
+    import dataclasses as _dc
+
+    from ..models.nanogpt import decode_config
+    from ..programs import serve_defs as sd
+
+    base = decode_config(_tiny_gpt_config())
+    mb = base.block_size // page_size
+    kv_pages = 2 + num_slots * mb
+    cfg_tuple = _dc.astuple(
+        _dc.replace(base, page_size=page_size, kv_pages=kv_pages))
+    defs = [sd.paged_prefill_def(cfg_tuple, int(b)) for b in buckets]
+    defs.append(sd.cow_def(cfg_tuple))
+    defs.append(sd.paged_decode_def(cfg_tuple, num_slots, decode_chunk))
+    defs.append(sd.spec_decode_def(cfg_tuple, num_slots, decode_chunk,
+                                   gamma))
+    return defs
+
+
 def engine_program_specs(num_slots: int = 2, decode_chunk: int = 4,
                          buckets: Sequence[int] = (8, 32)
                          ) -> List[ProgramSpec]:
-    """The serving engine's three program families, traced exactly as
-    ``serve/engine.py`` jits them (global LRU builders), with their real
-    donation masks: prefill (none), admit (cache, arg 0), decode (cache,
-    arg 1)."""
-    import dataclasses as _dc
-
-    from ..models.nanogpt import GPT, decode_config
-    from ..serve.engine import _prefill_program, _slot_programs
-
-    cfg = decode_config(_tiny_gpt_config())
-    cfg_tuple = _dc.astuple(cfg)
-    model = GPT(cfg)
-
-    params_tpl = jax.eval_shape(
-        lambda: model.init({"params": jax.random.PRNGKey(0)},
-                           jax.numpy.zeros((1, 1), np.int32),
-                           train=False))["params"]
-    row_cache_tpl = jax.eval_shape(
-        lambda: model.init({"params": jax.random.PRNGKey(0)},
-                           jax.numpy.zeros((1, 1), np.int32),
-                           train=False))["cache"]
-    slot_cache_tpl = jax.eval_shape(
-        lambda: model.init({"params": jax.random.PRNGKey(0)},
-                           jax.numpy.zeros((num_slots, 1), np.int32),
-                           train=False))["cache"]
-
-    scalar = lambda dt: jax.ShapeDtypeStruct((), dt)  # noqa: E731
-    vec = lambda dt: jax.ShapeDtypeStruct((num_slots,), dt)  # noqa: E731
-    key_t = jax.ShapeDtypeStruct((2,), np.uint32)
-
-    specs: List[ProgramSpec] = []
-    for bucket in buckets:
-        prefill = _prefill_program(cfg_tuple, int(bucket))
-        specs.append(ProgramSpec(
-            name=f"serve.prefill[bucket={bucket}]", fn=prefill,
-            args=(params_tpl,
-                  jax.ShapeDtypeStruct((1, int(bucket)), np.int32),
-                  scalar(np.int32), key_t, scalar(np.float32),
-                  scalar(np.int32), scalar(np.float32)),
-            donate_args=(), config={"config": cfg_tuple, "bucket": bucket},
-            family="serve.prefill"))
-
-    admit, decode = _slot_programs(cfg_tuple, num_slots, decode_chunk)
-    specs.append(ProgramSpec(
-        name=f"serve.admit[slots={num_slots}]", fn=admit,
-        args=(slot_cache_tpl, row_cache_tpl, scalar(np.int32),
-              scalar(np.int32)),
-        donate_args=(0,),
-        config={"config": cfg_tuple, "num_slots": num_slots},
-        family="serve.admit"))
-    specs.append(ProgramSpec(
-        name=f"serve.decode[slots={num_slots},chunk={decode_chunk}]",
-        fn=decode,
-        args=(params_tpl, slot_cache_tpl, vec(np.int32), vec(np.bool_),
-              jax.ShapeDtypeStruct((num_slots, 2), np.uint32),
-              vec(np.int32), vec(np.int32), vec(np.int32),
-              vec(np.float32), vec(np.int32), vec(np.float32)),
-        donate_args=(1,),
-        config={"config": cfg_tuple, "num_slots": num_slots,
-                "decode_chunk": decode_chunk},
-        family="serve.decode"))
-    specs.extend(paged_program_specs(num_slots=num_slots,
-                                     decode_chunk=decode_chunk,
-                                     buckets=buckets))
-    return specs
+    """The serving engine's program families, traced exactly as the
+    engine acquires them from the device-program registry, with their
+    real donation masks: prefill (none), admit (cache, arg 0), decode
+    (cache, arg 1), paged family (pool, arg 1 / CoW arg 0)."""
+    return [_spec_from_def(d)
+            for d in engine_program_defs(num_slots=num_slots,
+                                         decode_chunk=decode_chunk,
+                                         buckets=buckets)]
 
 
 def paged_program_specs(num_slots: int = 2, decode_chunk: int = 4,
                         buckets: Sequence[int] = (8, 32),
                         page_size: int = 8, gamma: int = 4
                         ) -> List[ProgramSpec]:
-    """The paged-KV/speculative program families (ISSUE 7), traced
-    exactly as the engine jits them: prefix-aware paged prefill (per
-    bucket), the copy-on-write page copy, the paged ``decode_chunk``
-    scan, and the fused draft+verify speculative program. All four
-    DONATE the page-pool cache — it is the multi-MB buffer threaded
-    linearly through every dispatch."""
-    import dataclasses as _dc
-
-    from ..models.nanogpt import GPT, decode_config
-    from ..serve.engine import (_cow_program, _paged_decode_program,
-                                _paged_prefill_program,
-                                _spec_decode_program)
-
-    base = decode_config(_tiny_gpt_config())
-    mb = base.block_size // page_size
-    kv_pages = 2 + num_slots * mb
-    cfg = _dc.replace(base, page_size=page_size, kv_pages=kv_pages)
-    cfg_tuple = _dc.astuple(cfg)
-    model = GPT(cfg)
-
-    pool_tpl = jax.eval_shape(
-        lambda: model.init(
-            {"params": jax.random.PRNGKey(0)},
-            jax.numpy.zeros((num_slots, 1), np.int32), train=False,
-            block_table=jax.numpy.zeros((num_slots, mb), np.int32),
-            cache_pos=jax.numpy.zeros((num_slots,), np.int32)))
-    params_tpl = pool_tpl["params"]
-    pool_tpl = pool_tpl["cache"]
-
-    scalar = lambda dt: jax.ShapeDtypeStruct((), dt)  # noqa: E731
-    vec = lambda dt: jax.ShapeDtypeStruct((num_slots,), dt)  # noqa: E731
-    bt_row = jax.ShapeDtypeStruct((1, mb), np.int32)
-    bt = jax.ShapeDtypeStruct((num_slots, mb), np.int32)
-    hist = jax.ShapeDtypeStruct((num_slots, base.block_size), np.int32)
-    key_t = jax.ShapeDtypeStruct((2,), np.uint32)
-    pcfg = {"config": cfg_tuple, "page_size": page_size,
-            "kv_pages": kv_pages}
-
-    specs: List[ProgramSpec] = []
-    for bucket in buckets:
-        prefill = _paged_prefill_program(cfg_tuple, int(bucket))
-        specs.append(ProgramSpec(
-            name=f"serve.paged_prefill[bucket={bucket}]", fn=prefill,
-            args=(params_tpl, pool_tpl, bt_row,
-                  jax.ShapeDtypeStruct((1,), np.int32),
-                  jax.ShapeDtypeStruct((1, int(bucket)), np.int32),
-                  scalar(np.int32), key_t, scalar(np.float32),
-                  scalar(np.int32), scalar(np.float32)),
-            donate_args=(1,), config={**pcfg, "bucket": bucket},
-            family="serve.paged_prefill"))
-    specs.append(ProgramSpec(
-        name=f"serve.cow[page={page_size}]", fn=_cow_program(cfg_tuple),
-        args=(pool_tpl, scalar(np.int32), scalar(np.int32)),
-        donate_args=(0,), config=pcfg, family="serve.cow"))
-    specs.append(ProgramSpec(
-        name=f"serve.paged_decode[slots={num_slots},"
-             f"chunk={decode_chunk}]",
-        fn=_paged_decode_program(cfg_tuple, num_slots, decode_chunk),
-        args=(params_tpl, pool_tpl, bt, vec(np.int32), vec(np.bool_),
-              vec(np.int32),
-              jax.ShapeDtypeStruct((num_slots, 2), np.uint32),
-              vec(np.int32), vec(np.int32), vec(np.int32),
-              vec(np.float32), vec(np.int32), vec(np.float32)),
-        donate_args=(1,),
-        config={**pcfg, "num_slots": num_slots,
-                "decode_chunk": decode_chunk},
-        family="serve.paged_decode"))
-    specs.append(ProgramSpec(
-        name=f"serve.spec_decode[slots={num_slots},chunk={decode_chunk},"
-             f"gamma={gamma}]",
-        fn=_spec_decode_program(cfg_tuple, num_slots, decode_chunk,
-                                gamma),
-        args=(params_tpl, pool_tpl, bt, hist, vec(np.int32),
-              vec(np.bool_), vec(np.int32),
-              jax.ShapeDtypeStruct((num_slots, 2), np.uint32),
-              vec(np.int32), vec(np.int32), vec(np.int32),
-              vec(np.float32), vec(np.int32), vec(np.float32)),
-        donate_args=(1,),
-        config={**pcfg, "num_slots": num_slots,
-                "decode_chunk": decode_chunk, "gamma": gamma},
-        family="serve.spec_decode"))
-    return specs
+    """Auditable specs for ``paged_program_defs`` (kept for direct
+    use; ``engine_program_specs`` already includes them)."""
+    return [_spec_from_def(d)
+            for d in paged_program_defs(num_slots=num_slots,
+                                        decode_chunk=decode_chunk,
+                                        buckets=buckets,
+                                        page_size=page_size,
+                                        gamma=gamma)]
 
 
 def shipped_programs(num_nodes: int = 4) -> List[ProgramSpec]:
@@ -502,13 +400,41 @@ def recompile_guard(audits: Sequence[ProgramAudit]) -> Dict[str, Any]:
             "n_keys": len(by_hash)}
 
 
+def registry_key_reconciliation(audits: Sequence[ProgramAudit]
+                                ) -> Dict[str, Any]:
+    """CI gate (ISSUE 9): the auditor's serve-program key set must equal
+    the key set a device-program registry derives from the SAME public
+    defs.  Both paths run ``programs.keys.program_key``, so a mismatch
+    means the audit's enumeration and the engine's acquisition path have
+    drifted apart — exactly the bespoke-cache split the unified registry
+    exists to prevent."""
+    from ..programs import ProgramRegistry
+
+    reg = ProgramRegistry()
+    for d in engine_program_defs():
+        reg.register(d)
+    registry_keys = set(reg.keys())
+    audit_keys = {a.key_hash for a in audits
+                  if a.name.startswith("serve.")}
+    return {
+        "n_registry_keys": len(registry_keys),
+        "n_audit_serve_keys": len(audit_keys),
+        "key_set_match": registry_keys == audit_keys,
+        "only_in_audit": sorted(audit_keys - registry_keys),
+        "only_in_registry": sorted(registry_keys - audit_keys),
+    }
+
+
 def audit_shipped_programs(num_nodes: int = 4) -> Dict[str, Any]:
     """Audit every shipped program; the CLI/CI entry point."""
     audits = [audit_program(s) for s in shipped_programs(num_nodes)]
     guard = recompile_guard(audits)
+    registry = registry_key_reconciliation(audits)
     n_findings = sum(len(a.findings) for a in audits)
     return {
         "programs": [a.as_dict() for a in audits],
         "recompile_guard": guard,
-        "violations": n_findings + len(guard["collisions"]),
+        "registry": registry,
+        "violations": (n_findings + len(guard["collisions"])
+                       + (0 if registry["key_set_match"] else 1)),
     }

@@ -804,7 +804,17 @@ def _cached_decode_program(cfg_tuple, b, t0, max_new_tokens, temperature,
                            top_k, top_p):
     """Compile the prefill+scan decode program once per (config, shape,
     sampling) signature — a fresh ``jax.jit`` per ``generate_fast`` call
-    would recompile every time (~seconds of fixed overhead per call)."""
+    would recompile every time (~seconds of fixed overhead per call).
+
+    Cross-config collision audit (ISSUE 9): the key leads with the FULL
+    ``decode_config`` astuple, so two different model configs can never
+    share an entry — every jit-static the closure bakes in (model
+    architecture, prompt shape, scan length, sampling params) is in the
+    key; only runtime values (params, prompt tokens, PRNG key) are not.
+    Pinned by ``tests/test_programs.py::test_generate_fast_cache_
+    distinguishes_configs``.  maxsize=32 bounds the distinct
+    (config × shape × sampling) signatures one process holds; eviction
+    costs a recompile, never wrong tokens."""
     cfg = GPTConfig(*cfg_tuple)
     model = GPT(cfg)
 

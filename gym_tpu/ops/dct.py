@@ -42,10 +42,16 @@ def largest_divisor_at_most(n: int, target: int) -> int:
     return best
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=64)
 def dct_matrix(n: int) -> np.ndarray:
     """Orthonormal DCT-II matrix D with D[k, m] = s_k · cos(π(2m+1)k / 2n),
-    s_0 = √(1/n), s_k = √(2/n). DCT(v) = D @ v; IDCT(v) = Dᵀ @ v."""
+    s_0 = √(1/n), s_k = √(2/n). DCT(v) = D @ v; IDCT(v) = Dᵀ @ v.
+
+    BOUNDED cache (ISSUE 9): one n per distinct chunk-divisor size; the
+    entries are n×n float32 matrices (the n=target_chunk worst case is
+    MBs), so an unbounded store leaks across a strategy sweep over many
+    model shapes. 64 covers every divisor family a sweep touches;
+    eviction costs one closed-form rebuild."""
     k = np.arange(n)[:, None]
     m = np.arange(n)[None, :]
     d = np.cos(np.pi * (2 * m + 1) * k / (2 * n))
@@ -54,9 +60,12 @@ def dct_matrix(n: int) -> np.ndarray:
     return d.astype(np.float32)
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=1024)
 def chunk_shape_for(shape: tuple, target_chunk: int) -> tuple:
-    """(rows_chunk, cols_chunk) tile sizes for a tensor of `shape`."""
+    """(rows_chunk, cols_chunk) tile sizes for a tensor of `shape`.
+    Bounded (ISSUE 9): keyed per distinct (tensor shape × chunk) — a
+    model contributes one entry per parameter shape; entries are two
+    ints, the bound only guards pathological shape churn."""
     if len(shape) == 0:
         return (1, 1)
     cols = int(np.prod(shape[:-1])) if len(shape) > 1 else 1
@@ -159,6 +168,11 @@ def sparse_decode_chunks(idx: jnp.ndarray, w: jnp.ndarray,
     return jnp.einsum("gm,gma,gmb->gab", w, ra, rb)
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=256)
 def codec_for(shape: tuple, target_chunk: int) -> ChunkedDCT:
+    """Bounded (ISSUE 9): one codec per (param shape × chunk); each
+    holds references to its two basis matrices, so an unbounded store
+    pins arbitrarily many ``dct_matrix`` products across a sweep. 256
+    comfortably covers one model's distinct param shapes; an evicted
+    codec is rebuilt from cached/cheap parts on the next DeMo step."""
     return ChunkedDCT(shape, target_chunk)

@@ -1,0 +1,56 @@
+"""Canonical device-program keys — ONE key function for the whole repo.
+
+A compiled XLA program is identified by ``(name × static config × input
+shapes/dtypes × donation mask)``.  ``program_key`` renders that
+descriptor as deterministic JSON plus its sha256[:16] hash — the key the
+unified device-program registry (``programs.registry``) stores
+executables under and the jaxpr auditor (``analysis/jaxpr_audit.py``)
+reports.  Both import THIS function, so the audit's key set and the
+registry's key set can only drift if a program's actual signature
+drifts — which is exactly the recompile the guard exists to catch.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+PyTree = Any
+
+
+def _leaf_avals(tree: PyTree) -> List[Tuple[Tuple[int, ...], str]]:
+    out = []
+    for leaf in jax.tree.leaves(tree):
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = str(np.dtype(getattr(leaf, "dtype", np.float32)))
+        out.append((shape, dtype))
+    return out
+
+
+def _jsonable_config(config: Dict[str, Any]) -> Dict[str, str]:
+    return {str(k): repr(v) for k, v in sorted(config.items())}
+
+
+def program_key(name: str, config: Dict[str, Any], args: Sequence[Any],
+                donate_args: Sequence[int],
+                out_avals: Optional[Sequence[Tuple]] = None
+                ) -> Tuple[str, str]:
+    """Canonical program key: ``(name × config × input shapes/dtypes ×
+    donation mask)`` as a deterministic JSON string plus its sha256[:16]
+    hash — the device-program-registry key. Two dispatches whose keys
+    hash equal may share a compiled executable; two programs with the
+    same ``name``/``config`` but different keys are a recompile."""
+    desc = {
+        "name": name,
+        "config": _jsonable_config(config),
+        "in_avals": [_leaf_avals(a) for a in args],
+        "donated": sorted(int(i) for i in donate_args),
+    }
+    if out_avals is not None:
+        desc["out_avals"] = list(out_avals)
+    canon = json.dumps(desc, sort_keys=True, separators=(",", ":"))
+    return canon, hashlib.sha256(canon.encode()).hexdigest()[:16]

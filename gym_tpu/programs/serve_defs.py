@@ -1,0 +1,504 @@
+"""The serving engine's device programs, as registry ``ProgramDef``s.
+
+This is the single source of truth for every program the inference
+engine dispatches — the bucketed prefill, the admit scatter, the fused
+``decode_chunk`` scan, and the paged-KV family (prefix-aware paged
+prefill, copy-on-write page copy, paged decode, fused draft+verify
+speculative decode).  ``serve/engine.py`` acquires them through the
+registry (replacing its six retired module-global ``lru_cache`` stores)
+and ``analysis/jaxpr_audit.py`` enumerates them through the same
+functions — so the auditor's key set and the registry's key set are the
+same set by construction, and a program signature drifting between the
+two is impossible rather than merely tested.
+
+Each ``ProgramDef`` carries the EXACT argument avals its engine call
+site dispatches with: the registry AOT-compiles against these templates
+and stores the ``Compiled`` executable, so a mismatch fails loudly at
+the first dispatch instead of silently recompiling.
+
+The builder bodies are documented where the semantics live:
+``serve/engine.py``'s module docstring (the program-set design) and the
+per-builder docstrings below.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.nanogpt import GPT, GPTConfig, sample_logits
+from .registry import ProgramDef
+
+# -- aval templates --------------------------------------------------------
+
+
+def _scalar(dt):
+    return jax.ShapeDtypeStruct((), dt)
+
+
+def _vec(n, dt):
+    return jax.ShapeDtypeStruct((n,), dt)
+
+
+_KEY_T = jax.ShapeDtypeStruct((2,), np.uint32)
+
+
+@functools.lru_cache(maxsize=64)
+def _templates(cfg_tuple: tuple, batch: int, paged: bool):
+    """``(params_tpl, cache_tpl)`` aval pytrees for a ``batch``-row
+    engine cache under this config — host-side ``eval_shape`` only,
+    nothing compiles.  Bounded lru: entries are tiny aval trees, keyed
+    by full config, and 64 far exceeds the distinct (config × batch)
+    pairs any process serves."""
+    cfg = GPTConfig(*cfg_tuple)
+    model = GPT(cfg)
+    dummy = jnp.zeros((batch, 1), jnp.int32)
+    if paged:
+        mb = cfg.block_size // cfg.page_size
+        shapes = jax.eval_shape(
+            lambda: model.init(
+                {"params": jax.random.PRNGKey(0)}, dummy, train=False,
+                block_table=jnp.zeros((batch, mb), jnp.int32),
+                cache_pos=jnp.zeros((batch,), jnp.int32)))
+    else:
+        shapes = jax.eval_shape(
+            lambda: model.init({"params": jax.random.PRNGKey(0)}, dummy,
+                               train=False))
+    return shapes["params"], shapes["cache"]
+
+
+# -- builders (the jitted closures the registry compiles) ------------------
+
+
+def build_prefill(cfg_tuple: tuple, bucket: int):
+    cfg = GPTConfig(*cfg_tuple)
+    model = GPT(cfg)
+
+    @jax.jit
+    def prefill(params, tokens, true_len, key, temp, top_k, top_p):
+        """tokens [1, bucket] right-padded; returns the sampled first
+        token [1] and the filled single-row cache. The first token is
+        sampled INSIDE the program (key schedule index 0) at the true
+        last prompt position, so no per-``true_len`` slicing program
+        exists outside this bucket's compile."""
+        logits, varsc = model.apply({"params": params}, tokens,
+                                    train=False, mutable=["cache"])
+        last = jax.lax.dynamic_index_in_dim(logits, true_len - 1, axis=1,
+                                            keepdims=False)   # [1, V]
+        tok = sample_logits(last, jax.random.fold_in(key, 0),
+                            temp, top_k, top_p)
+        return tok, varsc["cache"]
+
+    return prefill
+
+
+def build_slot_admit(cfg_tuple: tuple, num_slots: int):
+    # the engine cache is DONATED: it is multi-MB (num_slots ×
+    # block_size × n_embd × 2 × n_layer) and threaded linearly through
+    # the step loop — without donation every dispatch memcpys the whole
+    # thing, which on CPU dominates the step
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def admit(cache, row_cache, slot, true_len):
+        """Scatter a freshly prefilled single-row cache into slot ``slot``
+        and rewind that slot's integer cursors to ``true_len`` (the
+        prefill ran over the PADDED bucket, so its own cursor reads the
+        bucket length; pad K/V beyond ``true_len`` stays in the row but is
+        causally masked until each position is overwritten by decode)."""
+        def leaf(c, n):
+            if c.dtype == jnp.int32:     # per-row cursor ('i'/'pos') leaves
+                return c.at[slot].set(true_len)
+            return c.at[slot].set(n[0])
+
+        return jax.tree.map(leaf, cache, row_cache)
+
+    return admit
+
+
+def build_slot_decode(cfg_tuple: tuple, num_slots: int, chunk: int):
+    cfg = GPTConfig(*cfg_tuple)
+    model = GPT(cfg)
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def decode(params, cache, tok, active, base_keys, gen_idx,
+               remaining, eos, temp, top_k, top_p):
+        """``chunk`` decode steps for the whole slot batch in ONE
+        dispatch (a ``lax.scan``, amortizing per-dispatch overhead the
+        way ``generate_fast``'s whole-request scan does). Each scanned
+        step feeds every slot its current token and samples its next
+        with its own key/params. Slot lifecycle bookkeeping runs ON
+        DEVICE so no host round trip is needed mid-chunk: a slot that
+        hits EOS or exhausts ``remaining`` flips inactive and freezes —
+        its token and integer cursors stop advancing (no cache-overflow
+        creep, no garbage emission; its masked compute is the price of
+        the fixed shape until the next admit).
+
+        Returns ``(toks [chunk, S], emitted [chunk, S], last_logits
+        [S, V], final_tok, final_active, cache)`` — ``emitted`` marks
+        which scanned steps each slot was active for; the host replays
+        it to route tokens to requests."""
+        def body(carry, _):
+            cache, tok, act, gidx, rem, _lg = carry
+            logits, varsc = model.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                train=False, mutable=["cache"])
+            lg = logits[:, 0]                               # [S, V]
+            keys = jax.vmap(jax.random.fold_in)(base_keys, gidx)
+            nxt = jax.vmap(sample_logits)(lg, keys, temp, top_k, top_p)
+            nxt = jnp.where(act, nxt, tok).astype(jnp.int32)
+            new_cache = jax.tree.map(
+                lambda n, o: jnp.where(act, n, o)
+                if n.dtype == jnp.int32 else n,
+                varsc["cache"], cache)
+            emitted = act
+            gidx = jnp.where(act, gidx + 1, gidx)
+            rem = jnp.where(act, rem - 1, rem)
+            done = act & ((rem <= 0) | ((eos >= 0) & (nxt == eos)))
+            # last step's logits ride in the CARRY (teacher-forcing /
+            # debug observable) — stacking [chunk, S, V] would move the
+            # whole vocab per scanned step at GPT-2 vocab sizes
+            return ((new_cache, nxt, act & ~done, gidx, rem, lg),
+                    (nxt, emitted))
+
+        lg0 = jnp.zeros((num_slots, cfg.vocab_size), jnp.float32)
+        (cache, tok, active, gen_idx, remaining, lg), (toks, emitted) = \
+            jax.lax.scan(body,
+                         (cache, tok, active, gen_idx, remaining, lg0),
+                         None, length=chunk)
+        return toks, emitted, lg, tok, active, cache
+
+    return decode
+
+
+def build_paged_prefill(cfg_tuple: tuple, bucket: int):
+    cfg = GPTConfig(*cfg_tuple)
+    model = GPT(cfg)
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def prefill(params, cache, bt_row, start, tokens, true_suffix, key,
+                temp, top_k, top_p):
+        """Prefix-aware paged prefill: process only the SUFFIX tokens the
+        prefix cache could not supply. ``tokens`` [1, bucket] is the
+        right-padded suffix, ``start`` [1] the first suffix position
+        (= the shared-prefix length; attention gathers the resident
+        prefix K/V through ``bt_row``), ``true_suffix`` its unpadded
+        length. Samples the request's first token (key-schedule index 0)
+        at the true last prompt position and returns it with the updated
+        pool — the pool is DONATED: suffix K/V scatter in place."""
+        logits, varsc = model.apply(
+            {"params": params, "cache": cache}, tokens, train=False,
+            mutable=["cache"], block_table=bt_row, cache_pos=start)
+        last = jax.lax.dynamic_index_in_dim(logits, true_suffix - 1,
+                                            axis=1, keepdims=False)  # [1,V]
+        tok = sample_logits(last, jax.random.fold_in(key, 0),
+                            temp, top_k, top_p)
+        return tok, varsc["cache"]
+
+    return prefill
+
+
+def build_cow(cfg_tuple: tuple):
+    """Copy page ``src`` → ``dst`` across every layer's K/V pool: the
+    copy-on-write primitive for a shared block that must be appended
+    into (re-forwarding its tokens into the shared page instead would
+    perturb every other reader by the recompute's rounding)."""
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def cow(cache, src, dst):
+        return jax.tree.map(lambda c: c.at[dst].set(c[src]), cache)
+
+    return cow
+
+
+def build_paged_decode(cfg_tuple: tuple, num_slots: int, chunk: int):
+    """Paged twin of the slot decode: same fused ``decode_chunk`` scan
+    and on-device lifecycle, but K/V flow through the page pool via each
+    slot's block table and the per-row cursor is explicit carry state
+    (``pos``) instead of a cache variable. Inactive rows have their
+    tables redirected to the NULL page so their garbage writes can never
+    touch a page that was freed and reallocated to a live slot."""
+    cfg = GPTConfig(*cfg_tuple)
+    model = GPT(cfg)
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def decode(params, cache, bt, tok, active, pos, base_keys, gen_idx,
+               remaining, eos, temp, top_k, top_p):
+        def body(carry, _):
+            cache, tok, act, pos, gidx, rem, nanc, _lg = carry
+            bt_eff = jnp.where(act[:, None], bt, 0)
+            logits, varsc = model.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                train=False, mutable=["cache"], block_table=bt_eff,
+                cache_pos=pos)
+            lg = logits[:, 0]                           # [S, V]
+            # quarantine is latched PER ITERATION while the row is
+            # active: the null-page redirect means a finished row's
+            # later iterations read clean garbage, so (unlike the
+            # unpaged program) the LAST step's logits cannot witness a
+            # poison that struck mid-chunk
+            nanc = nanc | (act & ~jnp.isfinite(lg).all(axis=-1))
+            keys = jax.vmap(jax.random.fold_in)(base_keys, gidx)
+            nxt = jax.vmap(sample_logits)(lg, keys, temp, top_k, top_p)
+            nxt = jnp.where(act, nxt, tok).astype(jnp.int32)
+            emitted = act
+            pos = jnp.where(act, pos + 1, pos)
+            gidx = jnp.where(act, gidx + 1, gidx)
+            rem = jnp.where(act, rem - 1, rem)
+            done = act & ((rem <= 0) | ((eos >= 0) & (nxt == eos)))
+            return ((varsc["cache"], nxt, act & ~done, pos, gidx, rem,
+                     nanc, lg), (nxt, emitted))
+
+        lg0 = jnp.zeros((num_slots, cfg.vocab_size), jnp.float32)
+        nan0 = jnp.zeros((num_slots,), bool)
+        (cache, tok, active, pos, gen_idx, remaining, nan_seen, lg), \
+            (toks, emitted) = jax.lax.scan(
+                body, (cache, tok, active, pos, gen_idx, remaining,
+                       nan0, lg0), None, length=chunk)
+        return toks, emitted, lg, tok, active, pos, nan_seen, cache
+
+    return decode
+
+
+def _ngram_draft(hist, hist_len, tok, gamma: int):
+    """Vectorized n-gram (prompt-lookup) drafting: for each slot, find
+    the most recent earlier occurrence of the current BIGRAM
+    ``(hist[len-2], tok)`` in that slot's token history and propose the
+    ``gamma`` tokens that followed it. No match (or a match with no
+    continuation) falls back to repeating ``tok`` — correctness never
+    depends on draft quality, only throughput does: the verify step
+    samples every position from the true conditional with the request's
+    own key schedule, so ANY draft sequence yields the exact
+    non-speculative token stream."""
+    s, length = hist.shape
+    idx = jnp.arange(length - 1)
+    a = jnp.take_along_axis(
+        hist, jnp.clip(hist_len - 2, 0, length - 1)[:, None], axis=1)[:, 0]
+    m = (hist[:, :-1] == a[:, None]) & (hist[:, 1:] == tok[:, None])
+    # strictly BEFORE the current bigram (which always matches itself)
+    m = m & (idx[None, :] + 1 < hist_len[:, None] - 1)
+    has = m.any(axis=1)
+    j = jnp.max(jnp.where(m, idx[None, :], -1), axis=1)   # latest match
+    dpos = j[:, None] + 2 + jnp.arange(gamma)[None, :]
+    d = jnp.take_along_axis(hist, jnp.clip(dpos, 0, length - 1), axis=1)
+    ok = has[:, None] & (dpos < hist_len[:, None])
+    return jnp.where(ok, d, tok[:, None]).astype(jnp.int32)
+
+
+def build_spec_decode(cfg_tuple: tuple, num_slots: int, chunk: int,
+                      gamma: int):
+    """Self-drafting speculative decoding (arXiv 2302.01318), fused into
+    the ``decode_chunk`` scan: each scanned iteration drafts ``gamma``
+    tokens per slot by n-gram lookup over the slot's own token history,
+    scores ``[tok, d_1..d_γ]`` in ONE batched ``γ+1``-token model call,
+    then runs the vectorized accept/reject entirely on device.
+
+    EXACTNESS (stronger than the usual greedy-only guarantee): position
+    ``i``'s token is sampled from the true conditional
+    ``p(· | prefix, accepted_{<i})`` with the request's own key
+    ``fold_in(base, gen_idx+i)`` — the draft only decides how many of
+    those samples one dispatch may keep (the leading run where
+    ``sampled_i == draft_i``, plus one bonus token at the first
+    mismatch). The emitted stream is therefore IDENTICAL to the
+    non-speculative engine for EVERY sampling configuration, not just
+    greedy. Rejected drafts need no page copy: the rollback is a cursor
+    rewind — their K/V sit beyond the new cursor in slot-owned blocks,
+    causally masked until overwritten (exactly how padded prefill K/V
+    are retired)."""
+    cfg = GPTConfig(*cfg_tuple)
+    model = GPT(cfg)
+    g1 = int(gamma) + 1
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def spec(params, cache, bt, hist, tok, active, pos, base_keys,
+             gen_idx, remaining, eos, temp, top_k, top_p):
+        sample_row = jax.vmap(sample_logits,
+                              in_axes=(0, 0, None, None, None))
+
+        def body(carry, _):
+            cache, tok, act, pos, gidx, rem, hist, nanc, _lg = carry
+            hist_len = pos + 1                # prompt + emitted count
+            drafts = _ngram_draft(hist, hist_len, tok, gamma)   # [S, γ]
+            inp = jnp.concatenate([tok[:, None], drafts], axis=1)
+            bt_eff = jnp.where(act[:, None], bt, 0)
+            logits, varsc = model.apply(
+                {"params": params, "cache": cache}, inp, train=False,
+                mutable=["cache"], block_table=bt_eff, cache_pos=pos)
+            # latched per-iteration quarantine (see the paged decode
+            # program) — position 0 only: later positions may be
+            # LEGALLY NaN from the per-position window-overflow poison
+            # on rejected drafts, while position 0 is always in-window
+            # for an active row
+            nanc = nanc | (act & ~jnp.isfinite(logits[:, 0]).all(axis=-1))
+            idxs = gidx[:, None] + jnp.arange(g1)[None, :]
+            keys = jax.vmap(jax.vmap(jax.random.fold_in,
+                                     in_axes=(None, 0)))(base_keys, idxs)
+            sampled = jax.vmap(sample_row)(logits, keys, temp, top_k,
+                                           top_p)              # [S, γ+1]
+            match = (sampled[:, :gamma] == drafts).astype(jnp.int32)
+            acc = jnp.cumprod(match, axis=1).sum(axis=1)        # [S]
+            m = acc + 1                       # leading matches + bonus
+            pidx = jnp.arange(g1)[None, :]
+            is_eos = (eos[:, None] >= 0) & (sampled == eos[:, None])
+            eos_hit = is_eos & (pidx < m[:, None])
+            any_eos = eos_hit.any(axis=1)
+            m = jnp.where(any_eos, jnp.argmax(eos_hit, axis=1) + 1, m)
+            m = jnp.minimum(m, rem)           # max-tokens cap
+            m = jnp.where(act, m, 0)
+            emit = (pidx < m[:, None]) & act[:, None]           # [S, γ+1]
+            new_tok = jnp.take_along_axis(
+                sampled, jnp.maximum(m - 1, 0)[:, None], axis=1)[:, 0]
+            new_tok = jnp.where(act, new_tok, tok).astype(jnp.int32)
+            rem = rem - m
+            done = act & ((rem <= 0) | any_eos)
+            # history grows by the emitted tokens so the NEXT iteration's
+            # draft can match against them
+            rows = jnp.arange(num_slots)[:, None]
+            hpos = jnp.clip(hist_len[:, None] + pidx, 0,
+                            cfg.block_size - 1)
+            hist = hist.at[rows, hpos].set(
+                jnp.where(emit, sampled, hist[rows, hpos]))
+            lg = logits[:, 0]                 # teacher-forcing observable
+            return ((varsc["cache"], new_tok, act & ~done, pos + m,
+                     gidx + m, rem, hist, nanc, lg), (sampled, emit))
+
+        lg0 = jnp.zeros((num_slots, cfg.vocab_size), jnp.float32)
+        nan0 = jnp.zeros((num_slots,), bool)
+        (cache, tok, active, pos, gen_idx, remaining, hist, nan_seen,
+         lg), (toks, emit) = jax.lax.scan(
+                body, (cache, tok, active, pos, gen_idx, remaining,
+                       hist, nan0, lg0), None, length=chunk)
+        return toks, emit, lg, tok, active, pos, nan_seen, cache
+
+    return spec
+
+
+# -- ProgramDefs -----------------------------------------------------------
+
+
+def prefill_def(cfg_tuple: tuple, bucket: int) -> ProgramDef:
+    params_tpl, _ = _templates(cfg_tuple, 1, False)
+    return ProgramDef(
+        name=f"serve.prefill[bucket={bucket}]", family="serve.prefill",
+        config={"config": cfg_tuple, "bucket": bucket},
+        args=(params_tpl,
+              jax.ShapeDtypeStruct((1, int(bucket)), np.int32),
+              _scalar(np.int32), _KEY_T, _scalar(np.float32),
+              _scalar(np.int32), _scalar(np.float32)),
+        donate_args=(),
+        builder=lambda: build_prefill(cfg_tuple, int(bucket)))
+
+
+def slot_admit_def(cfg_tuple: tuple, num_slots: int) -> ProgramDef:
+    _, row_cache_tpl = _templates(cfg_tuple, 1, False)
+    _, slot_cache_tpl = _templates(cfg_tuple, num_slots, False)
+    return ProgramDef(
+        name=f"serve.admit[slots={num_slots}]", family="serve.admit",
+        config={"config": cfg_tuple, "num_slots": num_slots},
+        args=(slot_cache_tpl, row_cache_tpl, _scalar(np.int32),
+              _scalar(np.int32)),
+        donate_args=(0,),
+        builder=lambda: build_slot_admit(cfg_tuple, num_slots))
+
+
+def slot_decode_def(cfg_tuple: tuple, num_slots: int,
+                    chunk: int) -> ProgramDef:
+    params_tpl, slot_cache_tpl = _templates(cfg_tuple, num_slots, False)
+    s = num_slots
+    return ProgramDef(
+        name=f"serve.decode[slots={s},chunk={chunk}]",
+        family="serve.decode",
+        config={"config": cfg_tuple, "num_slots": s,
+                "decode_chunk": chunk},
+        args=(params_tpl, slot_cache_tpl, _vec(s, np.int32),
+              _vec(s, np.bool_), jax.ShapeDtypeStruct((s, 2), np.uint32),
+              _vec(s, np.int32), _vec(s, np.int32), _vec(s, np.int32),
+              _vec(s, np.float32), _vec(s, np.int32),
+              _vec(s, np.float32)),
+        donate_args=(1,),
+        builder=lambda: build_slot_decode(cfg_tuple, s, chunk))
+
+
+def _paged_cfg(cfg_tuple: tuple):
+    cfg = GPTConfig(*cfg_tuple)
+    if not cfg.page_size or not cfg.kv_pages:
+        raise ValueError(
+            "paged program defs need a config with page_size/kv_pages "
+            "set (the engine's dataclasses.replace'd decode config)")
+    mb = cfg.block_size // cfg.page_size
+    pcfg = {"config": cfg_tuple, "page_size": cfg.page_size,
+            "kv_pages": cfg.kv_pages}
+    return cfg, mb, pcfg
+
+
+def paged_prefill_def(cfg_tuple: tuple, bucket: int) -> ProgramDef:
+    _cfg, mb, pcfg = _paged_cfg(cfg_tuple)
+    params_tpl, pool_tpl = _templates(cfg_tuple, 1, True)
+    return ProgramDef(
+        name=f"serve.paged_prefill[bucket={bucket}]",
+        family="serve.paged_prefill",
+        config={**pcfg, "bucket": bucket},
+        args=(params_tpl, pool_tpl,
+              jax.ShapeDtypeStruct((1, mb), np.int32),
+              jax.ShapeDtypeStruct((1,), np.int32),
+              jax.ShapeDtypeStruct((1, int(bucket)), np.int32),
+              _scalar(np.int32), _KEY_T, _scalar(np.float32),
+              _scalar(np.int32), _scalar(np.float32)),
+        donate_args=(1,),
+        builder=lambda: build_paged_prefill(cfg_tuple, int(bucket)))
+
+
+def cow_def(cfg_tuple: tuple) -> ProgramDef:
+    cfg, _mb, pcfg = _paged_cfg(cfg_tuple)
+    _, pool_tpl = _templates(cfg_tuple, 1, True)
+    return ProgramDef(
+        name=f"serve.cow[page={cfg.page_size}]", family="serve.cow",
+        config=pcfg,
+        args=(pool_tpl, _scalar(np.int32), _scalar(np.int32)),
+        donate_args=(0,),
+        builder=lambda: build_cow(cfg_tuple))
+
+
+def paged_decode_def(cfg_tuple: tuple, num_slots: int,
+                     chunk: int) -> ProgramDef:
+    _cfg, mb, pcfg = _paged_cfg(cfg_tuple)
+    params_tpl, pool_tpl = _templates(cfg_tuple, num_slots, True)
+    s = num_slots
+    return ProgramDef(
+        name=f"serve.paged_decode[slots={s},chunk={chunk}]",
+        family="serve.paged_decode",
+        config={**pcfg, "num_slots": s, "decode_chunk": chunk},
+        args=(params_tpl, pool_tpl,
+              jax.ShapeDtypeStruct((s, mb), np.int32),
+              _vec(s, np.int32), _vec(s, np.bool_), _vec(s, np.int32),
+              jax.ShapeDtypeStruct((s, 2), np.uint32),
+              _vec(s, np.int32), _vec(s, np.int32), _vec(s, np.int32),
+              _vec(s, np.float32), _vec(s, np.int32),
+              _vec(s, np.float32)),
+        donate_args=(1,),
+        builder=lambda: build_paged_decode(cfg_tuple, s, chunk))
+
+
+def spec_decode_def(cfg_tuple: tuple, num_slots: int, chunk: int,
+                    gamma: int) -> ProgramDef:
+    cfg, mb, pcfg = _paged_cfg(cfg_tuple)
+    params_tpl, pool_tpl = _templates(cfg_tuple, num_slots, True)
+    s = num_slots
+    return ProgramDef(
+        name=f"serve.spec_decode[slots={s},chunk={chunk},gamma={gamma}]",
+        family="serve.spec_decode",
+        config={**pcfg, "num_slots": s, "decode_chunk": chunk,
+                "gamma": gamma},
+        args=(params_tpl, pool_tpl,
+              jax.ShapeDtypeStruct((s, mb), np.int32),
+              jax.ShapeDtypeStruct((s, cfg.block_size), np.int32),
+              _vec(s, np.int32), _vec(s, np.bool_), _vec(s, np.int32),
+              jax.ShapeDtypeStruct((s, 2), np.uint32),
+              _vec(s, np.int32), _vec(s, np.int32), _vec(s, np.int32),
+              _vec(s, np.float32), _vec(s, np.int32),
+              _vec(s, np.float32)),
+        donate_args=(1,),
+        builder=lambda: build_spec_decode(cfg_tuple, s, chunk, gamma))

@@ -146,6 +146,17 @@ def _build_parser() -> argparse.ArgumentParser:
                         "requests before failing them")
     p.add_argument("--metrics_dir", default=None,
                    help="serve.csv location (default: <RUN_DIR>/serve)")
+    p.add_argument("--program-cache-dir", default=None,
+                   help="enable the device-program registry's persistent "
+                        "executable tier at this directory (or set "
+                        "GYM_TPU_PROGRAM_CACHE_DIR): a restart against "
+                        "the same config deserializes every program "
+                        "instead of compiling — /stats "
+                        "programs_compiled stays 0")
+    p.add_argument("--no-warmup", action="store_true",
+                   help="skip the background AOT program warmup at "
+                        "startup (cold requests then pay compiles "
+                        "on-path — the pre-registry behavior)")
     p.add_argument("--device", default=None,
                    help="'cpu' pins the CPU backend (skips accelerator "
                         "plugin init)")
@@ -167,16 +178,30 @@ class ServerHandle:
     engine_factory: Any
     info: Dict[str, Any]
     router: Any = None
+    warmup: Any = None
 
     @property
     def port(self) -> int:
         return self.httpd.server_address[1]
+
+    def stop_warmup(self) -> None:
+        """Stop AND join the background warmup before teardown: the
+        warmup daemon thread may be inside an XLA compile/deserialize —
+        interpreter teardown while C++ holds that thread aborts the
+        process (SIGABRT after the clean-shutdown line; the ci_serve
+        restart drill caught it). stop() bounds the wait to the one
+        in-flight build. Shared by close() and main()'s SIGTERM drain
+        so the invariant cannot drift between the two paths."""
+        if self.warmup is not None:
+            self.warmup.stop()
+            self.warmup.join(timeout=120.0)
 
     def close(self, drain_deadline_s: float = 30.0) -> None:
         """Test-path teardown: stop every replica's driver, drain it
         (wedged replicas get their stacks dumped and their requests
         failed typed — handler threads blocked in result() must not pin
         server_close open), close sockets."""
+        self.stop_warmup()
         self.router.close(drain_deadline_s=drain_deadline_s)
         self.httpd.shutdown()
         self.httpd.server_close()
@@ -194,7 +219,9 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
                   page_size: int = 16, kv_pages: Optional[int] = None,
                   spec_tokens: int = 0, replicas: int = 1,
                   failover_retries: Optional[int] = None,
-                  reload_source: Optional[Any] = None) -> ServerHandle:
+                  reload_source: Optional[Any] = None,
+                  warmup: bool = True,
+                  program_cache_dir: Optional[str] = None) -> ServerHandle:
     """Build the full serving stack — replica fleet (engines, schedulers,
     supervisors, router), metrics, HTTP server — WITHOUT entering
     ``serve_forever``. ``main`` and the in-process chaos tests share
@@ -203,7 +230,17 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
     port (``handle.port`` reports it). ``reload_source(body) ->
     (params, weights_tag)`` supplies ``POST /reload``'s checkpoint
     re-read (absent: /reload answers 400; ``Router.reload`` still works
-    programmatically)."""
+    programmatically).
+
+    ``warmup=True`` starts a background thread precompiling the fleet's
+    COMPLETE program family (all power-of-two prefill buckets + the
+    decode/admit or paged/spec programs) through the device-program
+    registry before traffic needs them — cold-start p99 TTFT pays no
+    compiles.  ``program_cache_dir`` (or ``GYM_TPU_PROGRAM_CACHE_DIR``)
+    additionally enables the registry's persistent executable tier: a
+    restart against the same config deserializes every program instead
+    of compiling (``/stats`` → ``programs_compiled`` stays 0, pinned by
+    the ``scripts/ci_serve.sh`` restart drill)."""
     from ..data.build_dataset import CHAR_VOCAB
     from ..utils.checkpoint import CheckpointNotFoundError
     from ..utils.resilience import fault_point
@@ -238,9 +275,15 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
             "gym_tpu.serve: --spec_tokens requires the paged cache "
             "(--page_size > 0) — speculative decoding disabled\n")
 
+    from .. import programs as programs_mod
+    if program_cache_dir or os.environ.get("GYM_TPU_PROGRAM_CACHE_DIR"):
+        resolved = programs_mod.enable_disk_tier(program_cache_dir)
+        sys.stderr.write(
+            f"gym_tpu.serve: program registry disk tier at {resolved}\n")
+
     metrics = ServeMetrics(metrics_dir)
     # the params live in memory (restored from the checkpoint at
-    # startup); the global prefill/decode program LRUs make every
+    # startup); the process-wide device-program registry makes every
     # replica's engine — and any failover/hot-swap rebuild — warm:
     # same config, no recompiles
     router = build_fleet(
@@ -254,6 +297,15 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
                      if info.get("step") is not None else None))
     rep0 = router.replicas[0]
     sched, sup = rep0.scheduler, rep0.supervisor
+    warm_thread = None
+    if warmup:
+        # background AOT warmup over ONE replica's program family — all
+        # replicas share config, so one pass warms the whole fleet (and
+        # any future failover rebuild / hot-swap generation) through the
+        # shared registry; a request arriving mid-warmup single-flights
+        # into the same build instead of compiling twice
+        warm_thread = programs_mod.warm_engine_programs(
+            rep0.scheduler.engine, log=sys.stderr.write)
     char_level = cfg.vocab_size <= len(CHAR_VOCAB) + 1
 
     def encode_text(text: str):
@@ -335,6 +387,13 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
                                          for s in stats),
                 "spec_accept_rate": (accepted / drafted
                                      if drafted else None),
+                # device-program registry: XLA compiles this process has
+                # actually run (disk-tier deserializations excluded) —
+                # THE restart-drill observable (0 across a restart with
+                # a warm disk tier) — plus background-warmup progress
+                "programs_compiled": programs_mod.xla_compile_counter(),
+                "warmup": (warm_thread.stats()
+                           if warm_thread is not None else None),
                 # pre-fleet surface: replica 0's supervisor state (the
                 # keys every existing dashboard/drill greps)
                 **sup.status(),
@@ -542,7 +601,7 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
     return ServerHandle(httpd=httpd, scheduler=sched, supervisor=sup,
                         metrics=metrics,
                         engine_factory=rep0.engine_factory,
-                        info=info, router=router)
+                        info=info, router=router, warmup=warm_thread)
 
 
 def main(argv=None) -> int:
@@ -594,7 +653,9 @@ def main(argv=None) -> int:
         kv_pages=args.kv_pages, spec_tokens=args.spec_tokens,
         replicas=args.replicas,
         failover_retries=getattr(args, "failover_retries"),
-        reload_source=reload_source)
+        reload_source=reload_source,
+        warmup=not getattr(args, "no_warmup"),
+        program_cache_dir=getattr(args, "program_cache_dir"))
     httpd, metrics, router = handle.httpd, handle.metrics, handle.router
 
     watcher = None
@@ -623,6 +684,7 @@ def main(argv=None) -> int:
         stop.set()
         if watcher is not None:
             watcher.stop()
+        handle.stop_warmup()
         # per-replica drain: answer in-flight, fail queued typed; a
         # WEDGED replica gets its thread stacks dumped and its requests
         # failed typed without its engine ever being stepped from this

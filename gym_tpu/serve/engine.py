@@ -61,14 +61,18 @@ configuration — drafts only decide how many samples one dispatch keeps.
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.nanogpt import GPT, GPTConfig, decode_config, sample_logits
+from ..models.nanogpt import GPT, GPTConfig, decode_config
+from ..programs import default_registry
+from ..programs.serve_defs import (cow_def, paged_decode_def,
+                                   paged_prefill_def, prefill_def,
+                                   slot_admit_def, slot_decode_def,
+                                   spec_decode_def)
 from ..utils.resilience import fault_point
 
 PyTree = Any
@@ -294,318 +298,6 @@ class BlockAllocator:
         return self._cid
 
 
-# Program caches are GLOBAL (keyed by config/shape signature, like
-# models.nanogpt._cached_decode_program) so several engines over the same
-# model — tests, bench arms, server restarts in one process — share
-# compilations. Each engine still counts the buckets it touches for the
-# bounded-compilation observable.
-@functools.lru_cache(maxsize=64)
-def _prefill_program(cfg_tuple, bucket: int):
-    cfg = GPTConfig(*cfg_tuple)
-    model = GPT(cfg)
-
-    @jax.jit
-    def prefill(params, tokens, true_len, key, temp, top_k, top_p):
-        """tokens [1, bucket] right-padded; returns the sampled first
-        token [1] and the filled single-row cache. The first token is
-        sampled INSIDE the program (key schedule index 0) at the true
-        last prompt position, so no per-``true_len`` slicing program
-        exists outside this bucket's compile."""
-        logits, varsc = model.apply({"params": params}, tokens,
-                                    train=False, mutable=["cache"])
-        last = jax.lax.dynamic_index_in_dim(logits, true_len - 1, axis=1,
-                                            keepdims=False)   # [1, V]
-        tok = sample_logits(last, jax.random.fold_in(key, 0),
-                            temp, top_k, top_p)
-        return tok, varsc["cache"]
-
-    return prefill
-
-
-@functools.lru_cache(maxsize=32)
-def _slot_programs(cfg_tuple, num_slots: int, chunk: int):
-    cfg = GPTConfig(*cfg_tuple)
-    model = GPT(cfg)
-
-    # the engine cache is DONATED through both programs: it is multi-MB
-    # (num_slots × block_size × n_embd × 2 × n_layer) and threaded
-    # linearly through the step loop — without donation every dispatch
-    # memcpys the whole thing, which on CPU dominates the step
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def admit(cache, row_cache, slot, true_len):
-        """Scatter a freshly prefilled single-row cache into slot ``slot``
-        and rewind that slot's integer cursors to ``true_len`` (the
-        prefill ran over the PADDED bucket, so its own cursor reads the
-        bucket length; pad K/V beyond ``true_len`` stays in the row but is
-        causally masked until each position is overwritten by decode)."""
-        def leaf(c, n):
-            if c.dtype == jnp.int32:     # per-row cursor ('i'/'pos') leaves
-                return c.at[slot].set(true_len)
-            return c.at[slot].set(n[0])
-
-        return jax.tree.map(leaf, cache, row_cache)
-
-    @functools.partial(jax.jit, donate_argnums=(1,))
-    def decode(params, cache, tok, active, base_keys, gen_idx,
-               remaining, eos, temp, top_k, top_p):
-        """``chunk`` decode steps for the whole slot batch in ONE
-        dispatch (a ``lax.scan``, amortizing per-dispatch overhead the
-        way ``generate_fast``'s whole-request scan does). Each scanned
-        step feeds every slot its current token and samples its next
-        with its own key/params. Slot lifecycle bookkeeping runs ON
-        DEVICE so no host round trip is needed mid-chunk: a slot that
-        hits EOS or exhausts ``remaining`` flips inactive and freezes —
-        its token and integer cursors stop advancing (no cache-overflow
-        creep, no garbage emission; its masked compute is the price of
-        the fixed shape until the next admit).
-
-        Returns ``(toks [chunk, S], emitted [chunk, S], last_logits
-        [S, V], final_tok, final_active, cache)`` — ``emitted`` marks
-        which scanned steps each slot was active for; the host replays
-        it to route tokens to requests."""
-        def body(carry, _):
-            cache, tok, act, gidx, rem, _lg = carry
-            logits, varsc = model.apply(
-                {"params": params, "cache": cache}, tok[:, None],
-                train=False, mutable=["cache"])
-            lg = logits[:, 0]                               # [S, V]
-            keys = jax.vmap(jax.random.fold_in)(base_keys, gidx)
-            nxt = jax.vmap(sample_logits)(lg, keys, temp, top_k, top_p)
-            nxt = jnp.where(act, nxt, tok).astype(jnp.int32)
-            new_cache = jax.tree.map(
-                lambda n, o: jnp.where(act, n, o)
-                if n.dtype == jnp.int32 else n,
-                varsc["cache"], cache)
-            emitted = act
-            gidx = jnp.where(act, gidx + 1, gidx)
-            rem = jnp.where(act, rem - 1, rem)
-            done = act & ((rem <= 0) | ((eos >= 0) & (nxt == eos)))
-            # last step's logits ride in the CARRY (teacher-forcing /
-            # debug observable) — stacking [chunk, S, V] would move the
-            # whole vocab per scanned step at GPT-2 vocab sizes
-            return ((new_cache, nxt, act & ~done, gidx, rem, lg),
-                    (nxt, emitted))
-
-        lg0 = jnp.zeros((num_slots, cfg.vocab_size), jnp.float32)
-        (cache, tok, active, gen_idx, remaining, lg), (toks, emitted) = \
-            jax.lax.scan(body,
-                         (cache, tok, active, gen_idx, remaining, lg0),
-                         None, length=chunk)
-        return toks, emitted, lg, tok, active, cache
-
-    return admit, decode
-
-
-# -- paged-KV programs -----------------------------------------------------
-
-
-@functools.lru_cache(maxsize=64)
-def _paged_prefill_program(cfg_tuple, bucket: int):
-    cfg = GPTConfig(*cfg_tuple)
-    model = GPT(cfg)
-
-    @functools.partial(jax.jit, donate_argnums=(1,))
-    def prefill(params, cache, bt_row, start, tokens, true_suffix, key,
-                temp, top_k, top_p):
-        """Prefix-aware paged prefill: process only the SUFFIX tokens the
-        prefix cache could not supply. ``tokens`` [1, bucket] is the
-        right-padded suffix, ``start`` [1] the first suffix position
-        (= the shared-prefix length; attention gathers the resident
-        prefix K/V through ``bt_row``), ``true_suffix`` its unpadded
-        length. Samples the request's first token (key-schedule index 0)
-        at the true last prompt position and returns it with the updated
-        pool — the pool is DONATED: suffix K/V scatter in place."""
-        logits, varsc = model.apply(
-            {"params": params, "cache": cache}, tokens, train=False,
-            mutable=["cache"], block_table=bt_row, cache_pos=start)
-        last = jax.lax.dynamic_index_in_dim(logits, true_suffix - 1,
-                                            axis=1, keepdims=False)  # [1,V]
-        tok = sample_logits(last, jax.random.fold_in(key, 0),
-                            temp, top_k, top_p)
-        return tok, varsc["cache"]
-
-    return prefill
-
-
-@functools.lru_cache(maxsize=16)
-def _cow_program(cfg_tuple):
-    """Copy page ``src`` → ``dst`` across every layer's K/V pool: the
-    copy-on-write primitive for a shared block that must be appended
-    into (re-forwarding its tokens into the shared page instead would
-    perturb every other reader by the recompute's rounding)."""
-
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def cow(cache, src, dst):
-        return jax.tree.map(lambda c: c.at[dst].set(c[src]), cache)
-
-    return cow
-
-
-@functools.lru_cache(maxsize=32)
-def _paged_decode_program(cfg_tuple, num_slots: int, chunk: int):
-    """Paged twin of ``_slot_programs``' decode: same fused
-    ``decode_chunk`` scan and on-device lifecycle, but K/V flow through
-    the page pool via each slot's block table and the per-row cursor is
-    explicit carry state (``pos``) instead of a cache variable. Inactive
-    rows have their tables redirected to the NULL page so their garbage
-    writes can never touch a page that was freed and reallocated to a
-    live slot."""
-    cfg = GPTConfig(*cfg_tuple)
-    model = GPT(cfg)
-
-    @functools.partial(jax.jit, donate_argnums=(1,))
-    def decode(params, cache, bt, tok, active, pos, base_keys, gen_idx,
-               remaining, eos, temp, top_k, top_p):
-        def body(carry, _):
-            cache, tok, act, pos, gidx, rem, nanc, _lg = carry
-            bt_eff = jnp.where(act[:, None], bt, 0)
-            logits, varsc = model.apply(
-                {"params": params, "cache": cache}, tok[:, None],
-                train=False, mutable=["cache"], block_table=bt_eff,
-                cache_pos=pos)
-            lg = logits[:, 0]                           # [S, V]
-            # quarantine is latched PER ITERATION while the row is
-            # active: the null-page redirect means a finished row's
-            # later iterations read clean garbage, so (unlike the
-            # unpaged program) the LAST step's logits cannot witness a
-            # poison that struck mid-chunk
-            nanc = nanc | (act & ~jnp.isfinite(lg).all(axis=-1))
-            keys = jax.vmap(jax.random.fold_in)(base_keys, gidx)
-            nxt = jax.vmap(sample_logits)(lg, keys, temp, top_k, top_p)
-            nxt = jnp.where(act, nxt, tok).astype(jnp.int32)
-            emitted = act
-            pos = jnp.where(act, pos + 1, pos)
-            gidx = jnp.where(act, gidx + 1, gidx)
-            rem = jnp.where(act, rem - 1, rem)
-            done = act & ((rem <= 0) | ((eos >= 0) & (nxt == eos)))
-            return ((varsc["cache"], nxt, act & ~done, pos, gidx, rem,
-                     nanc, lg), (nxt, emitted))
-
-        lg0 = jnp.zeros((num_slots, cfg.vocab_size), jnp.float32)
-        nan0 = jnp.zeros((num_slots,), bool)
-        (cache, tok, active, pos, gen_idx, remaining, nan_seen, lg), \
-            (toks, emitted) = jax.lax.scan(
-                body, (cache, tok, active, pos, gen_idx, remaining,
-                       nan0, lg0), None, length=chunk)
-        return toks, emitted, lg, tok, active, pos, nan_seen, cache
-
-    return decode
-
-
-def _ngram_draft(hist, hist_len, tok, gamma: int):
-    """Vectorized n-gram (prompt-lookup) drafting: for each slot, find
-    the most recent earlier occurrence of the current BIGRAM
-    ``(hist[len-2], tok)`` in that slot's token history and propose the
-    ``gamma`` tokens that followed it. No match (or a match with no
-    continuation) falls back to repeating ``tok`` — correctness never
-    depends on draft quality, only throughput does: the verify step
-    samples every position from the true conditional with the request's
-    own key schedule, so ANY draft sequence yields the exact
-    non-speculative token stream."""
-    s, length = hist.shape
-    idx = jnp.arange(length - 1)
-    a = jnp.take_along_axis(
-        hist, jnp.clip(hist_len - 2, 0, length - 1)[:, None], axis=1)[:, 0]
-    m = (hist[:, :-1] == a[:, None]) & (hist[:, 1:] == tok[:, None])
-    # strictly BEFORE the current bigram (which always matches itself)
-    m = m & (idx[None, :] + 1 < hist_len[:, None] - 1)
-    has = m.any(axis=1)
-    j = jnp.max(jnp.where(m, idx[None, :], -1), axis=1)   # latest match
-    dpos = j[:, None] + 2 + jnp.arange(gamma)[None, :]
-    d = jnp.take_along_axis(hist, jnp.clip(dpos, 0, length - 1), axis=1)
-    ok = has[:, None] & (dpos < hist_len[:, None])
-    return jnp.where(ok, d, tok[:, None]).astype(jnp.int32)
-
-
-@functools.lru_cache(maxsize=32)
-def _spec_decode_program(cfg_tuple, num_slots: int, chunk: int,
-                         gamma: int):
-    """Self-drafting speculative decoding (arXiv 2302.01318), fused into
-    the ``decode_chunk`` scan: each scanned iteration drafts ``gamma``
-    tokens per slot by n-gram lookup over the slot's own token history,
-    scores ``[tok, d_1..d_γ]`` in ONE batched ``γ+1``-token model call,
-    then runs the vectorized accept/reject entirely on device.
-
-    EXACTNESS (stronger than the usual greedy-only guarantee): position
-    ``i``'s token is sampled from the true conditional
-    ``p(· | prefix, accepted_{<i})`` with the request's own key
-    ``fold_in(base, gen_idx+i)`` — the draft only decides how many of
-    those samples one dispatch may keep (the leading run where
-    ``sampled_i == draft_i``, plus one bonus token at the first
-    mismatch). The emitted stream is therefore IDENTICAL to the
-    non-speculative engine for EVERY sampling configuration, not just
-    greedy. Rejected drafts need no page copy: the rollback is a cursor
-    rewind — their K/V sit beyond the new cursor in slot-owned blocks,
-    causally masked until overwritten (exactly how padded prefill K/V
-    are retired)."""
-    cfg = GPTConfig(*cfg_tuple)
-    model = GPT(cfg)
-    g1 = int(gamma) + 1
-
-    @functools.partial(jax.jit, donate_argnums=(1,))
-    def spec(params, cache, bt, hist, tok, active, pos, base_keys,
-             gen_idx, remaining, eos, temp, top_k, top_p):
-        sample_row = jax.vmap(sample_logits,
-                              in_axes=(0, 0, None, None, None))
-
-        def body(carry, _):
-            cache, tok, act, pos, gidx, rem, hist, nanc, _lg = carry
-            hist_len = pos + 1                # prompt + emitted count
-            drafts = _ngram_draft(hist, hist_len, tok, gamma)   # [S, γ]
-            inp = jnp.concatenate([tok[:, None], drafts], axis=1)
-            bt_eff = jnp.where(act[:, None], bt, 0)
-            logits, varsc = model.apply(
-                {"params": params, "cache": cache}, inp, train=False,
-                mutable=["cache"], block_table=bt_eff, cache_pos=pos)
-            # latched per-iteration quarantine (see the paged decode
-            # program) — position 0 only: later positions may be
-            # LEGALLY NaN from the per-position window-overflow poison
-            # on rejected drafts, while position 0 is always in-window
-            # for an active row
-            nanc = nanc | (act & ~jnp.isfinite(logits[:, 0]).all(axis=-1))
-            idxs = gidx[:, None] + jnp.arange(g1)[None, :]
-            keys = jax.vmap(jax.vmap(jax.random.fold_in,
-                                     in_axes=(None, 0)))(base_keys, idxs)
-            sampled = jax.vmap(sample_row)(logits, keys, temp, top_k,
-                                           top_p)              # [S, γ+1]
-            match = (sampled[:, :gamma] == drafts).astype(jnp.int32)
-            acc = jnp.cumprod(match, axis=1).sum(axis=1)        # [S]
-            m = acc + 1                       # leading matches + bonus
-            pidx = jnp.arange(g1)[None, :]
-            is_eos = (eos[:, None] >= 0) & (sampled == eos[:, None])
-            eos_hit = is_eos & (pidx < m[:, None])
-            any_eos = eos_hit.any(axis=1)
-            m = jnp.where(any_eos, jnp.argmax(eos_hit, axis=1) + 1, m)
-            m = jnp.minimum(m, rem)           # max-tokens cap
-            m = jnp.where(act, m, 0)
-            emit = (pidx < m[:, None]) & act[:, None]           # [S, γ+1]
-            new_tok = jnp.take_along_axis(
-                sampled, jnp.maximum(m - 1, 0)[:, None], axis=1)[:, 0]
-            new_tok = jnp.where(act, new_tok, tok).astype(jnp.int32)
-            rem = rem - m
-            done = act & ((rem <= 0) | any_eos)
-            # history grows by the emitted tokens so the NEXT iteration's
-            # draft can match against them
-            rows = jnp.arange(num_slots)[:, None]
-            hpos = jnp.clip(hist_len[:, None] + pidx, 0,
-                            cfg.block_size - 1)
-            hist = hist.at[rows, hpos].set(
-                jnp.where(emit, sampled, hist[rows, hpos]))
-            lg = logits[:, 0]                 # teacher-forcing observable
-            return ((varsc["cache"], new_tok, act & ~done, pos + m,
-                     gidx + m, rem, hist, nanc, lg), (sampled, emit))
-
-        lg0 = jnp.zeros((num_slots, cfg.vocab_size), jnp.float32)
-        nan0 = jnp.zeros((num_slots,), bool)
-        (cache, tok, active, pos, gen_idx, remaining, hist, nan_seen,
-         lg), (toks, emit) = jax.lax.scan(
-                body, (cache, tok, active, pos, gen_idx, remaining,
-                       hist, nan0, lg0), None, length=chunk)
-        return toks, emit, lg, tok, active, pos, nan_seen, cache
-
-    return spec
-
-
 class InferenceEngine:
     """Slot-level mechanics: caches, prefill, the shared decode step.
 
@@ -638,7 +330,7 @@ class InferenceEngine:
         speculative decoding: each decode iteration drafts γ tokens by
         n-gram lookup and verifies them in one batched model call —
         token streams stay EXACTLY equal to the non-speculative engine
-        (see ``_spec_decode_program``).
+        (see ``programs.serve_defs.build_spec_decode``).
 
         ``weights_tag`` names the parameter set this engine serves (e.g.
         ``"step-120"``) — pure observability for the fleet router's
@@ -693,21 +385,32 @@ class InferenceEngine:
             self._alloc = None
         self.params = jax.tree.map(jnp.asarray, params)
         self._cfg_tuple = dataclasses.astuple(self.config)
+        # every program comes from the process-wide device-program
+        # registry (gym_tpu.programs): engines over the same config —
+        # replicas, supervisor rebuilds, hot-swapped generations —
+        # share ONE compiled executable per key, and the entries this
+        # engine holds are pinned against capacity eviction for its
+        # lifetime (released via weakref when the engine is collected)
+        self._registry = default_registry()
         if self.paged:
             self._admit_prog = None
-            self._decode_prog = _paged_decode_program(
-                self._cfg_tuple, self.num_slots, self.decode_chunk)
-            self._cow_prog = _cow_program(self._cfg_tuple)
+            self._decode_prog = self._acquire(paged_decode_def(
+                self._cfg_tuple, self.num_slots, self.decode_chunk))
+            self._cow_prog = self._acquire(cow_def(self._cfg_tuple))
             self._spec_prog = (
-                _spec_decode_program(self._cfg_tuple, self.num_slots,
-                                     self.decode_chunk, self.spec_tokens)
+                self._acquire(spec_decode_def(
+                    self._cfg_tuple, self.num_slots, self.decode_chunk,
+                    self.spec_tokens))
                 if self.spec_tokens else None)
         else:
-            self._admit_prog, self._decode_prog = _slot_programs(
-                self._cfg_tuple, self.num_slots, self.decode_chunk)
+            self._admit_prog = self._acquire(slot_admit_def(
+                self._cfg_tuple, self.num_slots))
+            self._decode_prog = self._acquire(slot_decode_def(
+                self._cfg_tuple, self.num_slots, self.decode_chunk))
             self._cow_prog = None
             self._spec_prog = None
         self._step1_prog = None          # lazy chunk-1 twin (teacher forcing)
+        self._prefill_progs: Dict[int, Any] = {}   # bucket → handle
         self._seen_buckets: set = set()
         self._cache = self._init_cache()
         s = self.num_slots
@@ -728,6 +431,64 @@ class InferenceEngine:
         self._base_keys = np.zeros((s, 2), np.uint32)
         self.stats = EngineStats(num_slots=s)
         self.last_logits: Optional[np.ndarray] = None  # [S, V] post-step
+
+    # -- device programs (registry-backed) --------------------------------
+
+    def _acquire(self, pdef):
+        return self._registry.acquire(pdef, pin_owner=self)
+
+    def _prefill_prog(self, bucket: int):
+        """Registry handle for this bucket's prefill program, ensured
+        built; bumps ``stats.prefill_compiles`` when the acquisition
+        actually built a new program (the bounded-compilation
+        observable — a program another engine over the same config
+        already built is a hit, not a compile)."""
+        h = self._prefill_progs.get(bucket)
+        if h is None:
+            pdef = (paged_prefill_def(self._cfg_tuple, bucket)
+                    if self.paged
+                    else prefill_def(self._cfg_tuple, bucket))
+            h = self._acquire(pdef)
+            self._prefill_progs[bucket] = h
+        # exact per-key attribution: ensure_reporting is True only if
+        # THIS call ran the build — a global-counter diff would charge
+        # concurrent warmup/sibling-replica builds to this request
+        if h.ensure_reporting():
+            self.stats.prefill_compiles += 1
+        return h
+
+    def warmup_defs(self) -> List[Any]:
+        """This engine's COMPLETE program family — what the background
+        warmup precompiles so no request ever pays a compile: the full
+        power-of-two prefill-bucket family plus the decode/admit (or
+        paged decode/CoW/spec) programs, traffic-critical first."""
+        buckets: List[int] = []
+        b = 1
+        while b < self.block_size:
+            buckets.append(b)
+            b <<= 1
+        buckets.append(self.block_size)
+        cfg, s, chunk = self._cfg_tuple, self.num_slots, self.decode_chunk
+        if self.paged:
+            defs = [paged_decode_def(cfg, s, chunk)]
+            if self.spec_tokens:
+                defs.append(spec_decode_def(cfg, s, chunk,
+                                            self.spec_tokens))
+            defs.append(cow_def(cfg))
+            if chunk != 1 or self.spec_tokens:
+                # the lazy chunk-1 twin (teacher forcing / eval
+                # harnesses) is part of the family too — without it a
+                # warmed or disk-restored process pays its compile on
+                # the first override_tokens step
+                defs.append(paged_decode_def(cfg, s, 1))
+            defs.extend(paged_prefill_def(cfg, b) for b in buckets)
+        else:
+            defs = [slot_decode_def(cfg, s, chunk),
+                    slot_admit_def(cfg, s)]
+            if chunk != 1:
+                defs.append(slot_decode_def(cfg, s, 1))
+            defs.extend(prefill_def(cfg, b) for b in buckets)
+        return defs
 
     def _init_cache(self) -> PyTree:
         model = GPT(self.config)
@@ -920,14 +681,7 @@ class InferenceEngine:
         else:
             bucket = prompt_bucket(n, self.block_size)
             self._seen_buckets.add(bucket)
-            # count true program-cache misses: the compile-bound
-            # observable is XLA compilations, and a program another
-            # engine over the same config already compiled is a hit,
-            # not a compile
-            before = _prefill_program.cache_info().misses
-            prefill = _prefill_program(self._cfg_tuple, bucket)
-            if _prefill_program.cache_info().misses > before:
-                self.stats.prefill_compiles += 1
+            prefill = self._prefill_prog(bucket)
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :n] = prompt
             tok, row_cache = prefill(
@@ -1018,10 +772,7 @@ class InferenceEngine:
             self._bt[slot] = 0
             self._bt[slot, :next_b + n_new] = row[:next_b + n_new]
             self._seen_buckets.add(bucket)
-            before = _paged_prefill_program.cache_info().misses
-            prefill = _paged_prefill_program(self._cfg_tuple, bucket)
-            if _paged_prefill_program.cache_info().misses > before:
-                self.stats.prefill_compiles += 1
+            prefill = self._prefill_prog(bucket)
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :suffix] = prompt[start:]
             tok, self._cache = prefill(
@@ -1108,12 +859,12 @@ class InferenceEngine:
             spec_run = False
             if self.decode_chunk != 1 or self._spec_prog is not None:
                 if self._step1_prog is None:
-                    if self.paged:
-                        self._step1_prog = _paged_decode_program(
-                            self._cfg_tuple, self.num_slots, 1)
-                    else:
-                        _, self._step1_prog = _slot_programs(
-                            self._cfg_tuple, self.num_slots, 1)
+                    self._step1_prog = self._acquire(
+                        paged_decode_def(self._cfg_tuple,
+                                         self.num_slots, 1)
+                        if self.paged
+                        else slot_decode_def(self._cfg_tuple,
+                                             self.num_slots, 1))
                 prog = self._step1_prog
         elif spec_run:
             prog = self._spec_prog

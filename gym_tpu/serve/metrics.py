@@ -70,6 +70,13 @@ HEADER = [
     # fleet serving: which replica produced the row (blank on
     # single-engine collectors and absent in pre-fleet CSVs)
     "replica_id",
+    # device-program registry counters (engine rows; absent in
+    # pre-registry CSVs — read_headline tolerates both): cumulative
+    # in-memory builds, builds that ran XLA (disk-tier hits excluded),
+    # and wall seconds inside builds. A restart/reload row whose
+    # programs_compiled matches the previous engine row is the
+    # zero-recompile seam, on disk.
+    "programs_built", "programs_compiled", "program_compile_s",
 ]
 
 #: EWMA smoothing for the live tokens/s estimate (per driver tick with
@@ -106,6 +113,17 @@ _STATUS_BY_EXC = {
     "DeadlineExceededError": "shed",
     "SlotQuarantinedError": "quarantined",
 }
+
+
+def _program_counters() -> Optional[Dict[str, Any]]:
+    """Live device-program-registry counters (plus the persistent-cache
+    event totals), or None if the registry is unimportable — metrics
+    must keep writing rows even if the programs package is broken."""
+    try:
+        from ..programs import default_registry, disk_event_counters
+        return {**default_registry().counters(), **disk_event_counters()}
+    except Exception:  # noqa: BLE001 — observability must not crash
+        return None
 
 
 class _RateState:
@@ -288,6 +306,17 @@ class ServeMetrics:
     def _rid_cell(replica_id: Optional[int]):
         return "" if replica_id is None else int(replica_id)
 
+    @staticmethod
+    def _program_cells() -> List[Any]:
+        """The device-program registry's cumulative build/compile
+        counters, as engine-row CSV cells (the serve.csv face of
+        ``programs.compile_counter()``)."""
+        c = _program_counters()
+        if c is None:
+            return ["", "", ""]
+        return [c["builds"], c["xla_compiles"],
+                f"{c['compile_seconds']:.3f}"]
+
     def request_done(self, req, queue_depth: int, active_slots: int,
                      replica_id: Optional[int] = None) -> None:
         with self._lock:
@@ -327,7 +356,7 @@ class ServeMetrics:
                 "" if ttft is None else f"{ttft:.5f}",
                 "" if lat is None else f"{lat:.5f}",
                 self.tokens_out, f"{self.tokens_per_s():.2f}",
-                "", "", "", self._rid_cell(replica_id),
+                "", "", "", self._rid_cell(replica_id), "", "", "",
             ])
             self._f.flush()
 
@@ -346,7 +375,7 @@ class ServeMetrics:
                 f"{self._now():.4f}", "request", "", "rejected",
                 queue_depth, active_slots, "", "", "", "",
                 self.tokens_out, f"{self.tokens_per_s():.2f}",
-                "", "", "", self._rid_cell(replica_id),
+                "", "", "", self._rid_cell(replica_id), "", "", "",
             ])
             self._f.flush()
 
@@ -363,7 +392,7 @@ class ServeMetrics:
                 f"{self._now():.4f}", "engine", "", "restart", "", "",
                 "", "", "", "", self.tokens_out,
                 f"{self.tokens_per_s():.2f}", "", "", "",
-                self._rid_cell(replica_id),
+                self._rid_cell(replica_id), *self._program_cells(),
             ])
             self._f.flush()
 
@@ -381,7 +410,7 @@ class ServeMetrics:
                 f"{self._now():.4f}", "engine", "", "reload", "", "",
                 "", "", "", "", self.tokens_out,
                 f"{self.tokens_per_s():.2f}", "", "", "",
-                self._rid_cell(replica_id),
+                self._rid_cell(replica_id), *self._program_cells(),
             ])
             self._f.flush()
 
@@ -422,7 +451,7 @@ class ServeMetrics:
                 stats.active_slots, "", "", "", "",
                 stats.tokens_generated, f"{self.tokens_per_s():.2f}",
                 kv, ph, ("" if sr is None else f"{sr:.4f}"),
-                self._rid_cell(replica_id),
+                self._rid_cell(replica_id), *self._program_cells(),
             ])
 
     def tokens_per_s(self) -> float:
@@ -491,6 +520,14 @@ class ServeMetrics:
                 "spec_accept_rate": (
                     round(sr, 4) if sr is not None else None),
             }
+            progs = _program_counters()
+            if progs is not None:
+                # the device-program registry's live counters (hits /
+                # builds / xla_compiles / disk_hits / compile_seconds +
+                # persistent-cache event totals) — /stats spreads the
+                # headline, so this is the wire observable the restart
+                # drill and the zero-recompile seams read
+                head["programs"] = progs
             if self._replicas:
                 head["replicas"] = {
                     str(rid): rep.headline()
@@ -545,6 +582,7 @@ def read_headline(path: str) -> Dict[str, Any]:
     ttfts: List[float] = []
     lats: List[float] = []
     kv_blocks, prefix_hits, spec_rate = 0, 0, None
+    programs: Optional[Dict[str, Any]] = None
     per_rep: Dict[str, Dict[str, int]] = {}
 
     def rep_of(row):
@@ -575,6 +613,15 @@ def read_headline(path: str) -> Dict[str, Any]:
                     prefix_hits = int(row["prefix_hit_blocks"])
                 if row.get("spec_accept_rate"):
                     spec_rate = float(row["spec_accept_rate"])
+                # registry counters: last engine sample wins (columns
+                # absent in pre-registry CSVs)
+                if row.get("programs_built"):
+                    programs = {
+                        "builds": int(row["programs_built"]),
+                        "xla_compiles": int(row["programs_compiled"]),
+                        "compile_seconds": float(
+                            row["program_compile_s"] or 0.0),
+                    }
                 continue
             if row["kind"] != "request":
                 continue
@@ -612,6 +659,8 @@ def read_headline(path: str) -> Dict[str, Any]:
         "prefix_hit_blocks": prefix_hits,
         "spec_accept_rate": spec_rate,
     }
+    if programs is not None:
+        head["programs"] = programs
     if per_rep:
         head["replicas"] = dict(sorted(per_rep.items()))
     head.update(_percentiles(ttfts, "ttft"))

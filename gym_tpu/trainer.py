@@ -655,6 +655,30 @@ class Trainer:
                if net_sim is not None else {}),
             **strategy.config(),
         }
+        # Device-program registry (ISSUE 9): the trainer's step programs
+        # register in the same keyed store the serving engine compiles
+        # through. Their avals exist only at the first dispatch (and the
+        # 0.4.x path must trace under the mesh context), so they go
+        # through ``track_jit`` — key computed from the first call's
+        # live avals, that call's compile (or persistent-cache
+        # deserialization) attributed to the registry counters.
+        from .programs import default_registry as _prog_registry
+        _reg = _prog_registry()
+        _prog_cfg = {k: v for k, v in config.items()
+                     if k not in ("seed", "max_steps", "num_epochs",
+                                  "network")}
+        _sname = config["strategy"]
+        train_step = _reg.track_jit(
+            f"trainer.step[{_sname}]", _prog_cfg, (0, 1), train_step,
+            family="trainer.step")
+        if multi_step is not None:
+            _ms_cfg = dict(_prog_cfg, steps_per_call=steps_per_call)
+            multi_step = _reg.track_jit(
+                f"trainer.multi_step[{_sname}]", _ms_cfg, (0, 1),
+                multi_step, family="trainer.step")
+        eval_step = _reg.track_jit(
+            f"trainer.eval_step[{_sname}]", _prog_cfg, (), eval_step,
+            family="trainer.eval")
         if ckpt is not None and primary:
             # snapshot the run config NEXT TO the step dirs (the CSVLogger
             # copy lives under log_dir, which serving has no way to find):

@@ -1,22 +1,25 @@
-"""Persistent XLA compilation cache wiring.
+"""Persistent XLA compilation cache wiring (registry-owned).
 
 The fit loop's warmup cost is dominated by XLA compiles of the node
 program (~40 s for the bench workload); JAX's persistent compilation
 cache makes repeated invocations of the same program — re-running
 ``bench.py``, iterating on a training script, resuming from a checkpoint
-— skip straight to execution. This module is the single place the knob
-is wired so ``Trainer.fit``, ``bench.py`` and user scripts all agree on
-resolution order: explicit argument > ``JAX_COMPILATION_CACHE_DIR`` env
-var > the gym-tpu default under ``~/.cache``.
+— skip straight to execution.
+
+Since ISSUE 9 the knob is OWNED by the unified device-program registry
+(``gym_tpu.programs.registry.enable_disk_tier``): the registry's
+persistent executable tier and this helper are the same JAX compilation
+cache, configured in one place, with hit/miss monitoring installed so
+``programs.xla_compile_counter()`` can attribute deserializations vs
+real compiles.  This module stays as the stable ``Trainer.fit`` /
+``bench.py`` entry point and simply delegates.
 """
 
 from __future__ import annotations
 
-import os
 from typing import Optional
 
-DEFAULT_CACHE_DIR = os.path.join(
-    os.path.expanduser("~"), ".cache", "gym_tpu", "xla_cache")
+from ..programs.registry import DEFAULT_CACHE_DIR  # noqa: F401 (re-export)
 
 
 def enable_compilation_cache(
@@ -30,17 +33,11 @@ def enable_compilation_cache(
     cache is consulted lazily at the first compile). Returns the resolved
     directory. ``min_compile_time_secs=0`` caches even sub-second
     compiles — useful for CPU test/bench programs; by default JAX only
-    persists compiles above ~1 s.
+    persists compiles above ~1 s (``None`` leaves JAX's threshold
+    untouched). Delegates to the device-program registry's
+    ``enable_disk_tier`` — one owner for the disk tier.
     """
-    import jax
+    from ..programs.registry import enable_disk_tier
 
-    cache_dir = (cache_dir
-                 or os.environ.get("JAX_COMPILATION_CACHE_DIR")
-                 or DEFAULT_CACHE_DIR)
-    os.makedirs(cache_dir, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_enable_compilation_cache", True)
-    if min_compile_time_secs is not None:
-        jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                          min_compile_time_secs)
-    return cache_dir
+    return enable_disk_tier(cache_dir,
+                            min_compile_time_secs=min_compile_time_secs)

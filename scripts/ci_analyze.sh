@@ -41,9 +41,16 @@ for name, summ in sections["trace"]["strategies"].items():
     assert summ["ok"], (name, summ)
 assert len(sections["trace"]["strategies"]) >= 8
 assert len(sections["audit"]["programs"]) >= 17
+# ISSUE 9 gate: the auditor's serve key set and the device-program
+# registry's key set are THE SAME set — enumeration and acquisition
+# cannot drift apart
+recon = sections["audit"]["registry"]
+assert recon["key_set_match"], recon
+assert recon["n_registry_keys"] == recon["n_audit_serve_keys"] >= 9, recon
 print("ci_analyze: violations=0 across",
       len(sections["trace"]["strategies"]), "strategy configs and",
-      len(sections["audit"]["programs"]), "programs")
+      len(sections["audit"]["programs"]), "programs;",
+      "registry reconciliation:", recon["n_registry_keys"], "keys match")
 EOF
 rc=$?
 [ "$rc" -ne 0 ] && exit "$rc"

@@ -59,9 +59,12 @@ EOF
 rc=$?
 [ "$rc" -ne 0 ] && { echo "ci_serve: training the smoke ckpt failed"; exit "$rc"; }
 
-# bare `python ... &` so $! is the server pid, not a subshell's
+# bare `python ... &` so $! is the server pid, not a subshell's.
+# --program-cache-dir seeds the device-program registry's persistent
+# executable tier — the restart drill below re-serves against it.
 env JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python -m gym_tpu.serve \
     --ckpt "$OUT/ckpts/ci" --port "$PORT" --num_slots 2 --device cpu \
+    --program-cache-dir "$OUT/progcache" \
     > "$OUT/server.log" 2>&1 &
 SRV=$!
 for _ in $(seq 1 90); do
@@ -119,6 +122,29 @@ rc=$?
 [ "$rc" -ne 0 ] && { echo "ci_serve: HTTP requests failed";
     cat "$OUT/server.log"; kill -9 "$SRV"; exit "$rc"; }
 
+# let the background AOT warmup finish before killing the server: the
+# restart drill needs EVERY program persisted to the cache dir, not
+# just the ones the requests above happened to touch
+timeout -k 10 120 env GYM_TPU_CI_SERVE_PORT="$PORT" python - <<'EOF'
+import json, os, time, urllib.request
+
+port = os.environ["GYM_TPU_CI_SERVE_PORT"]
+deadline = time.monotonic() + 110
+while time.monotonic() < deadline:
+    stats = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/stats", timeout=10).read())
+    w = stats.get("warmup")
+    if w is None or w.get("done"):
+        print("ci_serve: warmup done:", w)
+        break
+    time.sleep(1)
+else:
+    raise SystemExit(f"warmup never finished: {stats.get('warmup')}")
+EOF
+rc=$?
+[ "$rc" -ne 0 ] && { echo "ci_serve: warmup wait failed";
+    cat "$OUT/server.log"; kill -9 "$SRV"; exit "$rc"; }
+
 # SIGTERM drill: clean exit 0, shutdown line, headline line
 kill -TERM "$SRV"
 wait "$SRV"; rc=$?
@@ -130,5 +156,52 @@ grep -q "tokens_per_s" "$OUT/server.log" || {
     echo "ci_serve: no tokens_per_s headline"; cat "$OUT/server.log"; exit 1; }
 head -1 "$OUT/ckpts/ci/serve/serve.csv" | grep -q "ts_s,kind" || {
     echo "ci_serve: serve.csv missing/markerless"; exit 1; }
+
+# Restart drill (ISSUE 9): re-serve the SAME config against the seeded
+# program cache — the device-program registry must deserialize every
+# executable instead of compiling. Gate: first request returns 200 AND
+# /stats reports programs_compiled=0 (zero XLA compiles in the whole
+# restarted process).
+env JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python -m gym_tpu.serve \
+    --ckpt "$OUT/ckpts/ci" --port "$PORT" --num_slots 2 --device cpu \
+    --program-cache-dir "$OUT/progcache" \
+    > "$OUT/server2.log" 2>&1 &
+SRV=$!
+for _ in $(seq 1 90); do
+    grep -q "listening" "$OUT/server2.log" && break
+    kill -0 "$SRV" 2>/dev/null || { echo "ci_serve: restarted server died";
+        cat "$OUT/server2.log"; exit 1; }
+    sleep 1
+done
+grep -q "listening" "$OUT/server2.log" || {
+    echo "ci_serve: restarted server never started"; kill -9 "$SRV"; exit 1; }
+
+timeout -k 10 180 env GYM_TPU_CI_SERVE_PORT="$PORT" python - <<'EOF'
+import json, os, urllib.request
+
+port = os.environ["GYM_TPU_CI_SERVE_PORT"]
+body = json.dumps({"prompt": [1, 2, 3], "max_new_tokens": 6,
+                   "top_k": 4, "seed": 0}).encode()
+r = urllib.request.urlopen(urllib.request.Request(
+    f"http://127.0.0.1:{port}/generate", body,
+    {"Content-Type": "application/json"}), timeout=120)
+assert r.status == 200, r.status
+assert len(json.loads(r.read())["tokens"]) == 6
+stats = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/stats", timeout=10).read())
+assert stats["programs_compiled"] == 0, (
+    f"restart recompiled {stats['programs_compiled']} programs "
+    f"(registry: {stats.get('programs')})")
+print("ci_serve: restart drill — first request 200,",
+      "programs_compiled =", stats["programs_compiled"])
+EOF
+rc=$?
+[ "$rc" -ne 0 ] && { echo "ci_serve: restart drill failed";
+    cat "$OUT/server2.log"; kill -9 "$SRV"; exit "$rc"; }
+kill -TERM "$SRV"
+wait "$SRV"; rc=$?
+[ "$rc" -ne 0 ] && { echo "ci_serve: restarted server exit rc=$rc";
+    cat "$OUT/server2.log"; exit 1; }
+
 echo "ci_serve: OK (log at $OUT/server.log)"
 exit 0

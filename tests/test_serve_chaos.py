@@ -353,7 +353,12 @@ def _make_supervised(params, cfg, num_slots=2, metrics=None, **sup_kw):
 
 def test_supervisor_recovers_engine_exception(setup):
     """serve.decode raises at dispatch 2: the in-flight request fails
-    typed, the engine is rebuilt, the next request completes."""
+    typed, the engine is rebuilt, the next request completes — and the
+    rebuild is WARM: same config, same device-program registry, so the
+    failover rebuild + recovery request trigger ZERO new program builds
+    (the supervisor-failover zero-recompile seam, ISSUE 9)."""
+    from gym_tpu.programs import compile_counter
+
     cfg, model, params = setup
     sched, sup = _make_supervised(params, cfg, dispatch_timeout_s=30.0,
                                   max_restarts=3)
@@ -364,6 +369,10 @@ def test_supervisor_recovers_engine_exception(setup):
                                                        seed=3))
         with pytest.raises(EngineFailedError, match="InjectedFault"):
             h.result(timeout=60)
+        # the failed request built everything this config/bucket needs;
+        # everything from here — the supervisor's engine rebuild and the
+        # recovery request — must be served by the shared registry
+        builds0 = compile_counter()
         assert sup.restarts == 1
         ref = generate_fast(params, cfg, _prompt(5, 1)[None], 6,
                             temperature=0.8, top_k=5, seed=4)
@@ -371,6 +380,7 @@ def test_supervisor_recovers_engine_exception(setup):
             max_new_tokens=6, temperature=0.8, top_k=5, seed=4))
         assert h2.result(timeout=60) == ref[0, 5:].tolist()
         assert sup.failed is None
+        assert compile_counter() == builds0   # zero-recompile failover
     finally:
         sup.stop(join_timeout_s=10)
 

@@ -11,7 +11,7 @@ Acceptance oracles pinned here:
   failover and the dead replica is excluded from dispatch.
 - **hot-swap oracle** — roll new params through a 2-replica fleet under
   sustained concurrent traffic: ZERO failed/dropped requests, ZERO
-  recompiles (the global program LRUs are pinned by cache-miss deltas),
+  recompiles (pinned by the device-program registry's build counter),
   and post-swap generations provably come from the NEW params (exact
   ``generate_fast(params_b)`` match).
 - **deadline-forwarding satellite** — a failover retry carries the
@@ -39,7 +39,6 @@ import pytest
 import jax
 
 from gym_tpu.models.nanogpt import GPT, GPTConfig, generate_fast
-from gym_tpu.serve import engine as engine_mod
 from gym_tpu.serve.engine import InferenceEngine, SamplingParams
 from gym_tpu.serve.load import CheckpointWatcher, latest_checkpoint_step
 from gym_tpu.serve.metrics import ServeMetrics, read_headline
@@ -95,10 +94,10 @@ def _close(router, metrics):
 
 
 def _program_misses():
-    return (engine_mod._prefill_program.cache_info().misses
-            + engine_mod._paged_prefill_program.cache_info().misses
-            + engine_mod._slot_programs.cache_info().misses
-            + engine_mod._paged_decode_program.cache_info().misses)
+    # the device-program registry's shared build counter (ISSUE 9) —
+    # a delta of 0 across an operation is the zero-recompile pin
+    from gym_tpu.programs import compile_counter
+    return compile_counter()
 
 
 # -- dispatch -------------------------------------------------------------
@@ -288,7 +287,7 @@ def test_failover_forwards_remaining_deadline(setup):
 
 def test_rolling_hot_swap_under_traffic(setup, tmp_path):
     """Swap weights across the fleet under sustained concurrent traffic:
-    zero failed requests, zero recompiles (program-LRU misses pinned),
+    zero failed requests, zero recompiles (registry builds pinned),
     and a post-swap generation that matches ``generate_fast`` under the
     NEW params exactly."""
     cfg, params_a, params_b = setup
@@ -299,9 +298,14 @@ def test_rolling_hot_swap_under_traffic(setup, tmp_path):
         ref_b = generate_fast(params_b, cfg, probe[None], 8,
                               temperature=0.9, top_k=7,
                               seed=9)[0, 6:].tolist()
-        # warm every program before the pinned window
+        # warm every program before the pinned window: the clients below
+        # send prompts of 4..8 tokens, i.e. BOTH the 4- and 8-token
+        # prefill buckets (the shared registry means one warm request
+        # per bucket covers both replicas)
         router.submit(probe, SamplingParams(max_new_tokens=2,
                                             seed=0)).result(timeout=60)
+        router.submit(_prompt(4, 31), SamplingParams(
+            max_new_tokens=2, seed=0)).result(timeout=60)
         misses0 = _program_misses()
 
         def client(i):

@@ -288,11 +288,10 @@ def test_speculative_requires_paged(setup):
 def test_paged_prefill_compile_bound(setup):
     """32 distinct prompt lengths through the paged engine compile at
     most ⌈log2(block_size)⌉ + 1 prefill programs; decode and the fused
-    draft/verify are one program each (their LRU builders are keyed on
+    draft/verify are one program each (registry keys cover
     (config, slots, chunk[, γ]) only)."""
     cfg, model, params = setup
-    from gym_tpu.serve.engine import (_paged_decode_program,
-                                      _spec_decode_program)
+    from gym_tpu.programs import compile_counter, default_registry
     eng = InferenceEngine(params, cfg, num_slots=2, paged=True,
                           page_size=8)
     sched = Scheduler(eng, max_queue=64)
@@ -306,17 +305,21 @@ def test_paged_prefill_compile_bound(setup):
     assert eng.stats.prefill_compiles <= bound
     assert len(eng.stats.prefill_buckets) <= bound
     # one decode program per (config, slots, chunk); one spec program
-    # per (config, slots, chunk, γ) — the engines above share them
+    # per (config, slots, chunk, γ) — engines over the same config
+    # resolve to the SAME registry entry (same key, zero new builds)
+    builds0 = compile_counter()
     eng2 = InferenceEngine(params, cfg, num_slots=2, paged=True,
                            page_size=8)
-    assert eng2._decode_prog is eng._decode_prog
+    assert eng2._decode_prog.key_hash == eng._decode_prog.key_hash
     s1 = InferenceEngine(params, cfg, num_slots=2, paged=True,
                          page_size=8, spec_tokens=3)
     s2 = InferenceEngine(params, cfg, num_slots=2, paged=True,
                          page_size=8, spec_tokens=3)
-    assert s1._spec_prog is s2._spec_prog
-    assert _paged_decode_program.cache_info().currsize >= 1
-    assert _spec_decode_program.cache_info().currsize >= 1
+    assert s1._spec_prog.key_hash == s2._spec_prog.key_hash
+    assert compile_counter() == builds0   # re-acquisition compiles nothing
+    names = set(default_registry().keys().values())
+    assert any(n.startswith("serve.paged_decode[") for n in names)
+    assert any(n.startswith("serve.spec_decode[") for n in names)
 
 
 # -- allocator semantics ---------------------------------------------------
