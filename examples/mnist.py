@@ -24,8 +24,9 @@ import numpy as np
 from gym_tpu import Trainer
 from gym_tpu.data import ArrayDataset
 from gym_tpu.models import MnistLossModel
-from gym_tpu.strategy import (DeMoStrategy, DiLoCoStrategy, FedAvgStrategy,
-                              OptimSpec, SimpleReduceStrategy, SPARTAStrategy)
+from gym_tpu.strategy import (DeMoStrategy, DiLoCoStrategy, DynamiQStrategy,
+                              FedAvgStrategy, NoLoCoStrategy, OptimSpec,
+                              SimpleReduceStrategy, SPARTAStrategy)
 
 
 def load_mnist(train: bool):
@@ -80,6 +81,8 @@ def make_strategy(name: str, lr: float):
             optim_spec=OptimSpec("sgd", lr=lr),
             compression_decay=0.999, compression_topk=32,
             compression_chunk=64, **sched),
+        "noloco": lambda: NoLoCoStrategy(optim, H=100, **sched),
+        "dynamiq": lambda: DynamiQStrategy(optim, codec="int8", **sched),
     }[name]()
 
 
@@ -87,7 +90,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--strategy", default="sparta",
                    choices=["simple_reduce", "sparta", "diloco", "fedavg",
-                            "demo"])
+                            "demo", "noloco", "dynamiq"])
     p.add_argument("--num_nodes", type=int, default=2)
     p.add_argument("--num_epochs", type=int, default=1)
     p.add_argument("--max_steps", type=int, default=None)

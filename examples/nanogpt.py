@@ -29,20 +29,22 @@ import numpy as np
 from gym_tpu import Trainer
 from gym_tpu.data import ContiguousGPTTrainDataset, get_dataset
 from gym_tpu.models.nanogpt import GPT, GPTConfig
-from gym_tpu.strategy import (DeMoStrategy, DiLoCoStrategy, FedAvgStrategy,
-                              OptimSpec, SimpleReduceStrategy,
-                              SPARTADiLoCoStrategy, SPARTAStrategy,
-                              ZeroReduceStrategy)
+from gym_tpu.strategy import (DeMoStrategy, DiLoCoStrategy, DynamiQStrategy,
+                              FedAvgStrategy, NoLoCoStrategy, OptimSpec,
+                              SimpleReduceStrategy, SPARTADiLoCoStrategy,
+                              SPARTAStrategy, ZeroReduceStrategy)
 
 
 def gen_run_name(args) -> str:
     """Run-name generator (reference ``example/nanogpt.py:9-28``)."""
     parts = [args.dataset, args.model_size, args.strategy,
              f"{args.num_nodes}n", f"bs{args.batch_size}"]
-    if args.strategy in ("diloco", "diloco_sparta"):
+    if args.strategy in ("diloco", "diloco_sparta", "noloco"):
         parts.append(f"H{args.diloco_interval}")
     if args.strategy in ("sparta", "diloco_sparta"):
         parts.append(f"p{args.p_sparta}")
+    if args.strategy == "dynamiq":
+        parts.append(args.codec)
     if getattr(args, "participation", 1.0) < 1.0:
         parts.append(f"part{args.participation}")
     if getattr(args, "n_experts", 0):
@@ -108,6 +110,21 @@ def create_strategy(args):
             compression_topk=args.compression_topk,
             compression_chunk=args.compression_chunk,
             weight_decay=args.weight_decay, **sched)
+    if args.strategy == "noloco":
+        # all-reduce-free: shared-PRNG partner gossip every
+        # --diloco_interval steps (see strategy/noloco.py)
+        return NoLoCoStrategy(
+            optim_spec=optim,
+            outer_optim_spec=OptimSpec(
+                "sgd", lr=args.outer_lr, nesterov=args.nesterov,
+                momentum=args.outer_momentum),
+            H=args.diloco_interval, **sched)
+    if args.strategy == "dynamiq":
+        # compressed all-reduce: DDP sync pattern, codec'd payloads
+        # (see strategy/dynamiq.py)
+        kw = {"frac": args.topk_frac} if args.codec == "topk" else {}
+        return DynamiQStrategy(optim_spec=optim, codec=args.codec,
+                               **kw, **sched)
     raise ValueError(f"unknown strategy {args.strategy}")
 
 
@@ -144,7 +161,7 @@ def main():
     # strategy (:77-133)
     p.add_argument("--strategy", default="base",
                    choices=["base", "zero", "fedavg", "diloco", "sparta",
-                            "diloco_sparta", "demo"])
+                            "diloco_sparta", "demo", "noloco", "dynamiq"])
     p.add_argument("--H", type=int, default=1)
     p.add_argument("--island_size", type=int, default=None)
     p.add_argument("--p_sparta", type=float, default=0.005)
@@ -158,6 +175,11 @@ def main():
     p.add_argument("--compression_decay", type=float, default=0.999)
     p.add_argument("--compression_topk", type=int, default=32)
     p.add_argument("--compression_chunk", type=int, default=64)
+    p.add_argument("--codec", default="int8",
+                   choices=["int8", "int4", "topk"],
+                   help="dynamiq payload codec (strategy/compress.py)")
+    p.add_argument("--topk_frac", type=float, default=0.01,
+                   help="kept fraction for --codec topk")
     # TPU-native additions
     p.add_argument("--cp", type=int, default=1,
                    help="context-parallel devices per node (ring attention)")

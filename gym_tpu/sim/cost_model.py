@@ -93,6 +93,18 @@ def p2p_time(n_bytes: float, link: Link) -> float:
     return n_bytes / link.bandwidth + link.latency
 
 
+def gossip_round_time(n_bytes: float, pairs, topology: Topology) -> float:
+    """One randomized-gossip round (NoLoCo): every (sender, receiver)
+    pair exchanges ``n_bytes`` CONCURRENTLY, so the round costs the
+    slowest pair's single hop — priced on the link each pair actually
+    crosses (intra- vs inter-host on hierarchical topologies), not the
+    group bottleneck. Self-pairs (a node sitting a round out) are
+    free."""
+    times = [p2p_time(n_bytes, topology.link(i, j))
+             for i, j in pairs if i != j]
+    return max(times) if times else 0.0
+
+
 def collective_time(event: CollectiveEvent, topology: Topology,
                     algo: str = "ring") -> float:
     """Modeled wall-clock seconds for one collective event.
@@ -119,6 +131,8 @@ def collective_time(event: CollectiveEvent, topology: Topology,
     if event.op == "broadcast":
         return tree_broadcast_time(event.bytes, topology.bottleneck(g), g)
     if event.op == "p2p":
+        if event.pairs is not None:
+            return gossip_round_time(event.bytes, event.pairs, topology)
         return p2p_time(event.bytes, topology.bottleneck(g))
     raise ValueError(f"unknown collective op {event.op!r}")
 

@@ -39,13 +39,20 @@ import os
 from typing import Any, Dict, List, Optional
 
 # strategies that take a sync-interval H
-_H_STRATEGIES = ("diloco", "fedavg", "diloco_sparta")
+_H_STRATEGIES = ("diloco", "fedavg", "diloco_sparta", "noloco")
+# strategies that take a quantization bit-width (the compressed
+# all-reduce family)
+_BITS_STRATEGIES = ("dynamiq",)
 _STRATEGY_ALIASES = {
     "base": "simple_reduce", "allreduce": "simple_reduce",
     "zero": "zero_reduce", "sparta_diloco": "diloco_sparta",
+    "dynamiq_int8": "dynamiq", "dynamiq_int4": "dynamiq",
 }
+# aliases that NAME a bit-width pin it: `dynamiq_int8` runs int8 cells
+# whatever --bits says (the bare `dynamiq` name takes the --bits axis)
+_ALIAS_PINNED_BITS = {"dynamiq_int8": 8, "dynamiq_int4": 4}
 STRATEGIES = ("simple_reduce", "zero_reduce", "diloco", "fedavg",
-              "sparta", "diloco_sparta", "demo")
+              "sparta", "diloco_sparta", "demo", "noloco", "dynamiq")
 
 
 @dataclasses.dataclass
@@ -54,6 +61,7 @@ class SweepConfig:
     presets: List[str]
     nodes: List[int]
     H: List[int]
+    bits: List[int] = dataclasses.field(default_factory=lambda: [8])
     steps: int = 30
     batch_size: int = 8
     block_size: int = 64
@@ -67,12 +75,18 @@ class SweepConfig:
     out: str = os.path.join("logs", "sim_sweep")
 
     def __post_init__(self):
-        self.strategies = [_STRATEGY_ALIASES.get(s, s)
-                           for s in self.strategies]
+        # (resolved name, pinned bit-width or None) per requested entry
+        self._strategy_entries = [
+            (_STRATEGY_ALIASES.get(s, s), _ALIAS_PINNED_BITS.get(s))
+            for s in self.strategies]
+        self.strategies = [name for name, _ in self._strategy_entries]
         for s in self.strategies:
             if s not in STRATEGIES:
                 raise ValueError(f"unknown strategy {s!r}; "
                                  f"known: {STRATEGIES}")
+        for b in self.bits:
+            if b not in (4, 8):
+                raise ValueError(f"unknown bit-width {b!r}; known: 4, 8")
         if self.checkpoint_interval <= 0:
             self.checkpoint_interval = max(2, self.steps // 3)
 
@@ -83,31 +97,45 @@ class Cell:
     H: Optional[int]      # None for strategies without a sync interval
     nodes: int
     preset: str
+    bits: Optional[int] = None   # None for uncompressed strategies
 
     @property
     def cell_id(self) -> str:
         h = f"_H{self.H}" if self.H is not None else ""
-        return f"{self.strategy}{h}_n{self.nodes}_{self.preset}"
+        b = f"_int{self.bits}" if self.bits is not None else ""
+        return f"{self.strategy}{h}{b}_n{self.nodes}_{self.preset}"
 
 
 def grid(cfg: SweepConfig) -> List[Cell]:
-    """The deduplicated cell grid: H only multiplies strategies that
-    consume it."""
+    """The deduplicated cell grid: H and bits only multiply strategies
+    that consume them; a bit-pinned alias (`dynamiq_int8`) contributes
+    exactly its named cell, and a cell requested twice (e.g. `dynamiq`
+    with --bits 8 plus `dynamiq_int8`) runs once."""
     cells: List[Cell] = []
+    seen: set = set()
     for preset in cfg.presets:
         for n in cfg.nodes:
-            for s in cfg.strategies:
+            for s, pinned in cfg._strategy_entries:
                 hs = cfg.H if s in _H_STRATEGIES else [None]
+                if s in _BITS_STRATEGIES:
+                    bs = [pinned] if pinned is not None else cfg.bits
+                else:
+                    bs = [None]
                 for h in hs:
-                    cells.append(Cell(s, h, n, preset))
+                    for b in bs:
+                        cell = Cell(s, h, n, preset, b)
+                        if cell.cell_id not in seen:
+                            seen.add(cell.cell_id)
+                            cells.append(cell)
     return cells
 
 
-def make_strategy(name: str, H: Optional[int], lr: float):
-    from ..strategy import (DeMoStrategy, DiLoCoStrategy, FedAvgStrategy,
-                            OptimSpec, SimpleReduceStrategy,
-                            SPARTADiLoCoStrategy, SPARTAStrategy,
-                            ZeroReduceStrategy)
+def make_strategy(name: str, H: Optional[int], lr: float,
+                  bits: Optional[int] = None):
+    from ..strategy import (DeMoStrategy, DiLoCoStrategy, DynamiQStrategy,
+                            FedAvgStrategy, NoLoCoStrategy, OptimSpec,
+                            SimpleReduceStrategy, SPARTADiLoCoStrategy,
+                            SPARTAStrategy, ZeroReduceStrategy)
     optim = OptimSpec("adamw", lr=lr)
     if name == "simple_reduce":
         return SimpleReduceStrategy(optim_spec=optim)
@@ -124,6 +152,11 @@ def make_strategy(name: str, H: Optional[int], lr: float):
     if name == "demo":
         from ..strategy import OptimSpec as _OS
         return DeMoStrategy(optim_spec=_OS("sgd", lr=lr))
+    if name == "noloco":
+        return NoLoCoStrategy(optim_spec=optim, H=H)
+    if name == "dynamiq":
+        return DynamiQStrategy(optim_spec=optim,
+                               codec=f"int{bits or 8}")
     raise ValueError(name)
 
 
@@ -205,7 +238,7 @@ def run_cell(cell: Cell, cfg: SweepConfig) -> Dict[str, Any]:
     from .. import Trainer
 
     model, ds = _workload(cfg, cell.nodes)
-    strategy = make_strategy(cell.strategy, cell.H, cfg.lr)
+    strategy = make_strategy(cell.strategy, cell.H, cfg.lr, cell.bits)
     run_dir = os.path.join(cfg.out, "logs", cell.cell_id)
     res = Trainer(model, ds).fit(
         strategy=strategy,
@@ -260,6 +293,7 @@ def run_cell(cell: Cell, cfg: SweepConfig) -> Dict[str, Any]:
         "cell": cell.cell_id,
         "strategy": cell.strategy,
         "H": cell.H,
+        "bits": cell.bits,
         "nodes": cell.nodes,
         "topology": cell.preset,
         "steps": res.steps,
@@ -283,9 +317,13 @@ def run_cell(cell: Cell, cfg: SweepConfig) -> Dict[str, Any]:
 def _write_csv(path: str, rows: List[Dict[str, Any]]) -> None:
     if not rows:
         return
+    # union of keys, first-row order first: cells cached by an older
+    # sweep build may lack newer columns (e.g. `bits`)
     cols = list(rows[0].keys())
+    for r in rows[1:]:
+        cols.extend(k for k in r.keys() if k not in cols)
     with open(path, "w", newline="") as f:
-        w = csv.DictWriter(f, fieldnames=cols)
+        w = csv.DictWriter(f, fieldnames=cols, restval="")
         w.writeheader()
         w.writerows(rows)
 
@@ -298,6 +336,66 @@ def _baseline_of(rows: List[Dict[str, Any]], row) -> Optional[Dict]:
                 and r["topology"] == row["topology"]):
             return r
     return None
+
+
+def _config_label(r: Dict[str, Any]) -> str:
+    """Human label for one cell's strategy configuration."""
+    label = r["strategy"]
+    if r.get("H") is not None:
+        label += f" H={r['H']}"
+    if r.get("bits") is not None:
+        label += f" int{r['bits']}"
+    return label
+
+
+def pareto_frontier(group: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """The Pareto-efficient subset of one (topology, nodes) group over
+    (simulated total seconds ↓, final loss ↓): a cell is ON the
+    frontier iff no other cell is at least as fast AND at least as
+    converged with one strictly better. Ties keep both. Diverged cells
+    (non-finite loss) never reach the frontier — NaN compares False
+    against everything, which would otherwise make them undominatable."""
+    import math
+    rows = [r for r in group
+            if r.get("sim_total_s") is not None
+            and math.isfinite(r["final_train_loss"])]
+
+    def dominated(r):
+        return any(
+            o is not r
+            and o["sim_total_s"] <= r["sim_total_s"]
+            and o["final_train_loss"] <= r["final_train_loss"]
+            and (o["sim_total_s"] < r["sim_total_s"]
+                 or o["final_train_loss"] < r["final_train_loss"])
+            for o in rows)
+
+    return sorted((r for r in rows if not dominated(r)),
+                  key=lambda r: r["sim_total_s"])
+
+
+def write_frontier_csv(path: str, rows: List[Dict[str, Any]]) -> None:
+    """``frontier.csv``: every cell with its Pareto verdict, grouped by
+    (topology, nodes) — the one artifact that answers 'which strategy
+    wins where' without eyeballing results.csv."""
+    out: List[Dict[str, Any]] = []
+    groups = sorted({(r["topology"], r["nodes"]) for r in rows})
+    for preset, n in groups:
+        group = [r for r in rows
+                 if r["topology"] == preset and r["nodes"] == n]
+        front = {id(r) for r in pareto_frontier(group)}
+        for r in sorted(group, key=lambda r: r["sim_total_s"] or 0.0):
+            out.append({
+                "topology": preset, "nodes": n,
+                "config": _config_label(r),
+                "strategy": r["strategy"], "H": r.get("H"),
+                "bits": r.get("bits"),
+                "sim_total_s": r["sim_total_s"],
+                "sim_comm_s": r["sim_comm_s"],
+                "final_train_loss": r["final_train_loss"],
+                "comm_mb_per_node": round(r["cum_comm_bytes"] / 1e6, 3),
+                "on_frontier": id(r) in front,
+            })
+    _write_csv(path, out)
 
 
 def write_report(rows: List[Dict[str, Any]], cfg: SweepConfig) -> str:
@@ -318,10 +416,10 @@ def write_report(rows: List[Dict[str, Any]], cfg: SweepConfig) -> str:
                 continue
             lines.append(f"## {preset} × {n} nodes")
             lines.append("")
-            lines.append("| strategy | H | sim wall-clock (s) | "
+            lines.append("| strategy | H | bits | sim wall-clock (s) | "
                          "sim comm (s) | vs AllReduce | comm/node (MB) | "
                          "final loss | trace reconciles |")
-            lines.append("|---|---|---|---|---|---|---|---|")
+            lines.append("|---|---|---|---|---|---|---|---|---|")
             base = _baseline_of(group, group[0])
             for r in sorted(group, key=lambda r: r["sim_total_s"] or 0.0):
                 speed = (base["sim_total_s"] / r["sim_total_s"]
@@ -331,12 +429,32 @@ def write_report(rows: List[Dict[str, Any]], cfg: SweepConfig) -> str:
                     headline = (r, base, speed)
                 lines.append(
                     f"| {r['strategy']} | {r['H'] or '—'} "
+                    f"| {r.get('bits') or '—'} "
                     f"| {r['sim_total_s']:.2f} | {r['sim_comm_s']:.2f} "
                     f"| {f'{speed:.1f}x' if speed else '—'} "
                     f"| {r['cum_comm_bytes'] / 1e6:.2f} "
                     f"| {r['final_train_loss']:.4f} "
                     f"| {'yes' if r['reconciled'] else 'NO'} |")
             lines.append("")
+    # Pareto frontier: the strategies actually worth running per
+    # (topology, nodes) — loss and simulated seconds trade, a cheap
+    # strategy that converges slower can still lose
+    lines.append("## Pareto frontier (final loss vs simulated seconds)")
+    lines.append("")
+    for preset in cfg.presets:
+        for n in cfg.nodes:
+            group = [r for r in rows
+                     if r["topology"] == preset and r["nodes"] == n]
+            front = pareto_frontier(group)
+            if not front:
+                continue
+            members = ", ".join(
+                f"{_config_label(r)} ({r['sim_total_s']:.2f}s, "
+                f"loss {r['final_train_loss']:.4f})" for r in front)
+            lines.append(f"- **{preset} × {n} nodes**: {members}")
+    lines.append("")
+    lines.append("Full per-cell verdicts: `frontier.csv`.")
+    lines.append("")
     if headline is not None:
         r, base, speed = headline
         lines.insert(2, (
@@ -414,6 +532,7 @@ def run_sweep(cfg: SweepConfig) -> List[Dict[str, Any]]:
               f"loss={row['final_train_loss']:.4f} "
               f"reconciled={row['reconciled']}")
     _write_csv(os.path.join(cfg.out, "results.csv"), rows)
+    write_frontier_csv(os.path.join(cfg.out, "frontier.csv"), rows)
     _atomic_json(os.path.join(cfg.out, "results.json"),
                  {"config": dataclasses.asdict(cfg), "rows": rows})
     report = write_report(rows, cfg)
@@ -439,7 +558,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "(datacenter, wan, federated)")
     p.add_argument("--nodes", default="4", help="comma list of node counts")
     p.add_argument("--H", default="10",
-                   help="comma list of sync intervals (diloco/fedavg)")
+                   help="comma list of sync intervals "
+                        "(diloco/fedavg/noloco)")
+    p.add_argument("--bits", default="8",
+                   help="comma list of quantization bit-widths for the "
+                        "compressed strategies (dynamiq): 8, 4")
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--batch_size", type=int, default=8)
     p.add_argument("--block_size", type=int, default=64)
@@ -468,6 +591,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         presets=_csv_list(args.preset),
         nodes=[int(x) for x in _csv_list(args.nodes)],
         H=[int(x) for x in _csv_list(args.H)],
+        bits=[int(x) for x in _csv_list(args.bits)],
         steps=args.steps, batch_size=args.batch_size,
         block_size=args.block_size, n_layer=args.n_layer,
         n_head=max(1, args.n_embd // 32), n_embd=args.n_embd,

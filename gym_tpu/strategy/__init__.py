@@ -9,10 +9,13 @@ reference's re-exports — SURVEY §2.1).
 from .base import CollectiveEvent, Strategy, StrategyLifecycleError
 from .communicate_optimize import (CommunicateOptimizeStrategy,
                                    CommunicationModule)
+from .compress import Codec, QuantizeCodec, TopKCodec, make_codec
 from .demo import DeMoStrategy
 from .diloco import DiLoCoCommunicator, DiLoCoStrategy
+from .dynamiq import DynamiQStrategy
 from .faults import alive_mask, masked_mean, participation_round
 from .fedavg import AveragingCommunicator, FedAvgStrategy
+from .noloco import NoLoCoCommunicator, NoLoCoStrategy
 from .optim import OptimSpec, ensure_optim_spec
 from .simple_reduce import SimpleReduceStrategy
 from .zero_reduce import ZeroReduceStrategy
@@ -43,6 +46,13 @@ __all__ = [
     "PartitionedIndexSelector",
     "SPARTADiLoCoStrategy",
     "DeMoStrategy",
+    "NoLoCoStrategy",
+    "NoLoCoCommunicator",
+    "DynamiQStrategy",
+    "Codec",
+    "QuantizeCodec",
+    "TopKCodec",
+    "make_codec",
     "alive_mask",
     "masked_mean",
     "participation_round",

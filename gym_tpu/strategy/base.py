@@ -100,6 +100,27 @@ class CollectiveEvent:
     # whose accounting deliberately differs (DeMo counts its payload once,
     # FedAvg islands count one model transmit) pin it explicitly.
     tx_bytes: Optional[float] = None
+    # For `p2p` gossip rounds: the (sender, receiver) node pairs of this
+    # round's exchange, all concurrent. The cost model then prices the
+    # round as the SLOWEST pair's single hop over the actual link each
+    # pair crosses (intra- vs inter-host on hierarchical topologies)
+    # instead of a serial sum — a gossip round where every node talks to
+    # one partner is one network round-trip, not K of them. None for the
+    # non-p2p ops (and for p2p messages priced on the bottleneck link).
+    pairs: Optional[tuple] = None
+    # For events whose declared (wire) bytes deliberately differ from
+    # what the SPMD emulation moves (compressed payloads, masked
+    # exchanges, p2p-via-gather): the DENSE bytes the emulation is
+    # expected to move for this event, in the extracted-site convention
+    # (all_reduce/reduce_scatter = full input vector, all_gather =
+    # assembled output). The static verifier uses it as an UPPER BOUND
+    # on the jaxpr's moved bytes — a strategy that quietly moves more
+    # than its declared emulation (e.g. an undeclared residual gather
+    # folded into a declared hop) fails reconciliation even though the
+    # wire accounting still matches. None = no bound declared (the
+    # pre-existing strategies' realized-vs-moved splits are grandfathered
+    # by the metric check alone).
+    emulated_bytes: Optional[float] = None
 
     def __post_init__(self):
         if self.op not in COLLECTIVE_OPS:

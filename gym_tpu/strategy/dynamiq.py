@@ -1,0 +1,191 @@
+"""DynamiQ: compressed multi-hop all-reduce for gradient synchronization.
+
+Reference: DynamiQ (arXiv:2602.08923) keeps plain DDP's synchronization
+pattern — a gradient all-reduce every step — but quantizes the payload
+of each hop of the multi-hop collective, cutting wire bytes by
+~bits/32 without touching the training schedule. The canonical two-hop
+decomposition is exactly ZeRO's: reduce-scatter the (compressed)
+gradient, then all-gather the (compressed) reduced chunks, so per-node
+wire traffic drops from ``2(K−1)/K·|g|`` f32 bytes to
+``2(K−1)/K·C(|g|)`` codec bytes.
+
+Implementation over the gym's node axis:
+
+- both hops compress with a codec from ``strategy/compress.py``
+  (int8/int4 stochastic-rounding quantization or top-k with error
+  feedback), with the rounding keys folded from the SHARED
+  ``(seed, step, hop)`` PRNG so every node draws the same noise
+  schedule — agreement without communication;
+- on a pure node mesh the canonical ``psum_scatter`` + ``all_gather``
+  schedule runs; under vnode folding (``psum_scatter`` has no batching
+  rule) the reduce-scatter hop falls back to ``pmean`` + slice — the
+  zero_reduce precedent. Both paths apply the SAME codec noise to the
+  same values, so they compute identical parameters
+  (``tests/test_strategies.py`` pins it);
+- the SPMD emulation moves dense f32 either way; ``comm_bytes`` and the
+  declared ``comm_events`` price the codec's honest ``wire_bytes``
+  (data + per-tile scales / top-k indices) on the CANONICAL compressed
+  schedule — the algorithm's wire protocol, independent of which
+  emulation ran. The static verifier accepts the split only because the
+  folded metric matches the declaration exactly (the SPARTA
+  realized-vs-moved rule), and the vnode fallback's ``pmean`` is
+  recognized as emulating the declared reduce-scatter.
+- top-k is biased, so it carries an error-feedback residual in the
+  strategy state at BOTH compression points (the double-EF recipe,
+  Tang et al. arXiv:1905.05957): ``residual`` re-injects this node's
+  dropped gradient mass into next step's hop-1 payload, ``residual2``
+  does the same for this node's reduced chunk at hop 2 — without it,
+  mass dropped at hop 2 would vanish permanently (hop 1's residual is
+  computed before the reduction and cannot see it). Quantization is
+  unbiased (stochastic rounding) and carries none.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.flatten_util import ravel_pytree
+
+from .base import (CollectiveEvent, PyTree, Strategy,
+                   StrategyLifecycleError, comm_metric, require_finalized,
+                   tree_num_params)
+from .compress import Codec, hop_keys, make_codec
+from .optim import OptimSpec, ensure_optim_spec
+
+
+class DynamiQStrategy(Strategy):
+    """DDP with both all-reduce hops compressed (see module doc)."""
+
+    def __init__(
+        self,
+        optim_spec: Optional[Union[str, OptimSpec]] = None,
+        codec: Union[str, Codec, None] = None,
+        max_norm: Optional[float] = None,
+        lr_scheduler=None,
+        lr_scheduler_kwargs=None,
+        seed: int = 2602,   # arXiv 2602.08923
+        **codec_kwargs,
+    ):
+        super().__init__(lr_scheduler, lr_scheduler_kwargs, max_norm)
+        self.optim_spec = ensure_optim_spec(optim_spec, OptimSpec("adamw"))
+        self.codec = make_codec(codec, **codec_kwargs)
+        self.seed = int(seed)
+        self.tx: optax.GradientTransformation | None = None
+
+    def _build(self):
+        self.tx = self.optim_spec.build(self._lr_scale)
+
+    def init(self, params: PyTree) -> PyTree:
+        require_finalized(self)
+        state = {"opt": self.tx.init(params)}
+        if self.codec.error_feedback:
+            n = tree_num_params(params)
+            state["residual"] = jnp.zeros((n,), jnp.float32)
+            if self._ctx is None:
+                raise StrategyLifecycleError(
+                    "DynamiQStrategy with an error-feedback codec needs "
+                    "the node mesh before init to size the hop-2 "
+                    "residual: the Trainer binds it, or call "
+                    "strategy.bind_ctx(runtime.ctx).")
+            k = self._ctx.num_nodes
+            state["residual2"] = jnp.zeros((-(-n // k),), jnp.float32)
+        return state
+
+    # -- wire accounting (the algorithm's, not the emulation's) -----------
+
+    def _wires(self, n: int, k: int):
+        """(hop-1 wire bytes, hop-2 wire bytes) for an ``n``-element
+        gradient over ``k`` nodes: hop 1 compresses each node's full
+        flat gradient (reduce-scatter input), hop 2 each node's reduced
+        1/K chunk (all-gather input; bytes convention = assembled
+        output, so ×k)."""
+        shard = -(-n // k)
+        return (self.codec.wire_bytes(n),
+                k * self.codec.wire_bytes(shard))
+
+    def step(self, grads, params, state, step, ctx):
+        k = ctx.num_nodes
+        flat_g, unravel = ravel_pytree(grads)
+        n = flat_g.size
+        new_state = dict(state)
+
+        if k == 1:
+            # nothing on the wire → nothing to compress (codec noise is
+            # the price of communication, not a regularizer)
+            mean_tree = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+            comm = 0.0
+        else:
+            shard = -(-n // k)
+            pad = k * shard - n
+            k_hop1, k_hop2 = hop_keys(self.seed, step)
+            send = flat_g.astype(jnp.float32)
+            if self.codec.error_feedback:
+                send = send + state["residual"]
+            g_hat = self.codec.roundtrip(send, k_hop1)
+            if self.codec.error_feedback:
+                new_state["residual"] = send - g_hat
+            g_pad = jnp.pad(g_hat, (0, pad))
+
+            if len(ctx.axes) == 1:
+                # canonical hop 1: reduce-scatter of the compressed
+                # gradient — each node receives only its summed chunk
+                chunk = ctx.reduce_scatter(g_pad) / k
+            else:
+                # vnode fallback (zero_reduce precedent): full mean +
+                # slice; same values, different emulation schedule
+                chunk = lax.dynamic_slice(
+                    ctx.pmean(g_pad), (ctx.node_index() * shard,), (shard,))
+
+            # hop 2: compress the reduced chunk, gather everyone's
+            # (double EF: this node owns the same chunk index every
+            # step, so the residual stays aligned)
+            send2 = chunk
+            if self.codec.error_feedback:
+                send2 = send2 + state["residual2"]
+            chunk_hat = self.codec.roundtrip(send2, k_hop2)
+            if self.codec.error_feedback:
+                new_state["residual2"] = send2 - chunk_hat
+            gathered = ctx.all_gather(chunk_hat)    # [K, shard]
+            mean_flat = gathered.reshape(-1)[:n]
+            mean_tree = unravel(mean_flat)
+            w1, w2 = self._wires(n, k)
+            comm = (k - 1) / k * (w1 + w2)
+
+        mean_tree = self._maybe_clip(mean_tree, ctx)
+        mean_tree = jax.tree.map(lambda m, g: m.astype(g.dtype),
+                                 mean_tree, grads)
+        updates, opt_state = self.tx.update(mean_tree, state["opt"], params)
+        params = optax.apply_updates(params, updates)
+        new_state["opt"] = opt_state
+        return params, new_state, {"comm_bytes": comm_metric(comm)}
+
+    def comm_events(self, step: int, params: PyTree,
+                    num_nodes: int) -> List[CollectiveEvent]:
+        if num_nodes <= 1:
+            return []
+        n = tree_num_params(params)
+        w1, w2 = self._wires(n, num_nodes)
+        # always the CANONICAL compressed schedule — the algorithm's
+        # wire protocol; the vnode emulation moves different dense
+        # bytes but accounts these same compressed ones. emulated_bytes
+        # bounds what the dense emulation may legitimately move per hop
+        # (the padded flat f32 vector): the verifier rejects a step that
+        # quietly gathers anything more (e.g. an undeclared residual
+        # exchange folded into a declared hop).
+        dense = 4.0 * num_nodes * (-(-n // num_nodes))   # padded f32
+        return [
+            CollectiveEvent("reduce_scatter", w1, num_nodes,
+                            label="grads_compressed", emulated_bytes=dense),
+            CollectiveEvent("all_gather", w2, num_nodes,
+                            label="chunks_compressed", emulated_bytes=dense),
+        ]
+
+    def config(self):
+        cfg = super().config()
+        cfg.update(self.codec.config())
+        cfg["codec_seed"] = self.seed
+        return cfg

@@ -14,7 +14,7 @@ cd "$(dirname "$0")/.."
 
 rm -f /tmp/_sim.log
 timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
-    tests/test_sim.py tests/test_sweep.py -q \
+    tests/test_sim.py tests/test_sweep.py tests/test_compress.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly \
     2>&1 | tee /tmp/_sim.log
 rc=${PIPESTATUS[0]}
@@ -23,11 +23,14 @@ echo SIM_DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' \
 [ "$rc" -ne 0 ] && exit "$rc"
 
 # CLI smoke sweep: fresh out dir (a stale one would resume-skip every
-# cell and test nothing), 2 strategies × 2 topologies, tiny steps.
+# cell and test nothing), 4 strategies × 2 topologies, tiny steps —
+# including the ISSUE 10 low-communication cells (noloco gossip,
+# dynamiq-int8 compressed all-reduce).
 SWEEP_OUT=${GYM_TPU_CI_SWEEP_OUT:-/tmp/gym_tpu_ci_sweep}
 rm -rf "$SWEEP_OUT"
-timeout -k 10 300 env JAX_PLATFORMS=cpu python -m gym_tpu.sim.sweep \
-    --preset wan,datacenter --strategies diloco,simple_reduce \
+timeout -k 10 420 env JAX_PLATFORMS=cpu python -m gym_tpu.sim.sweep \
+    --preset wan,datacenter \
+    --strategies diloco,simple_reduce,noloco,dynamiq_int8 \
     --nodes 2 --steps 8 --batch_size 4 --block_size 32 \
     --n_layer 1 --n_embd 32 --out "$SWEEP_OUT"
 rc=$?
@@ -36,5 +39,14 @@ grep -q "Headline: DiLoCo" "$SWEEP_OUT/report.md" || {
     echo "ci_sim: sweep report missing the DiLoCo headline"; exit 1; }
 grep -q "RECONCILIATION FAILURES" "$SWEEP_OUT/report.md" && {
     echo "ci_sim: trace/cum_comm_bytes reconciliation failed"; exit 1; }
+# the low-comm cells ran, reconciled, and reached the frontier artifact
+for cell in noloco_H10_n2_wan dynamiq_int8_n2_wan; do
+    grep -q "\"cell\": \"$cell\"" "$SWEEP_OUT/results.json" || {
+        echo "ci_sim: sweep missing cell $cell"; exit 1; }
+done
+grep -q "^wan,2,noloco" "$SWEEP_OUT/frontier.csv" || {
+    echo "ci_sim: frontier.csv missing the noloco verdict row"; exit 1; }
+grep -q "^wan,2,dynamiq int8" "$SWEEP_OUT/frontier.csv" || {
+    echo "ci_sim: frontier.csv missing the dynamiq verdict row"; exit 1; }
 echo "ci_sim: OK (report at $SWEEP_OUT/report.md)"
 exit 0

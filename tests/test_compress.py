@@ -1,0 +1,168 @@
+"""Codec-layer unit tests (ISSUE 10): round-trip error bounds, unbiased
+stochastic rounding, bit-exact lossless configs, error-feedback decay,
+and the honest wire accounting every compressed strategy declares."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gym_tpu.strategy.compress import (QuantizeCodec, TopKCodec, hop_keys,
+                                       make_codec)
+
+
+def _vec(n=1000, seed=0, scale=3.0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(size=n).astype(np.float32) * scale)
+
+
+# -- quantization ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("bits", [8, 4])
+def test_quantize_roundtrip_error_bounded_by_tile_scale(bits):
+    """|x − decompress(compress(x))| ≤ one quantization bin per element:
+    bin = tile_amax / qmax (stochastic rounding moves at most one bin)."""
+    codec = QuantizeCodec(bits=bits, tile=128)
+    x = _vec(1000)
+    xh = codec.roundtrip(x, jax.random.PRNGKey(1))
+    assert xh.shape == x.shape and xh.dtype == jnp.float32
+    tiles = np.asarray(
+        jnp.pad(x, (0, 24)).reshape(-1, 128))  # 1000 → 8 tiles of 128
+    bin_per_tile = np.abs(tiles).max(axis=1) / codec.qmax
+    err = np.abs(np.asarray(xh - x)).reshape(-1)
+    bound = np.repeat(bin_per_tile, 128)[:1000] * (1 + 1e-6)
+    assert np.all(err <= bound), float((err - bound).max())
+
+
+def test_stochastic_rounding_is_unbiased():
+    """E[decompress] = x over independent rounding keys — the property
+    that lets DynamiQ skip error feedback for quantization (codec noise
+    averages out instead of accumulating as bias)."""
+    codec = QuantizeCodec(bits=4, tile=64)     # coarse: 7 levels, big bins
+    x = _vec(256, seed=2)
+    keys = jax.random.split(jax.random.PRNGKey(3), 400)
+    mean = np.mean(
+        [np.asarray(codec.roundtrip(x, k)) for k in keys], axis=0)
+    bin_size = float(jnp.abs(x).max()) / codec.qmax
+    # MC error of a ±bin/2 uniform-ish residual over 400 draws
+    np.testing.assert_allclose(mean, np.asarray(x),
+                               atol=bin_size * 0.2)
+
+
+def test_deterministic_rounding_is_reproducible_and_key_free():
+    codec = QuantizeCodec(bits=8, stochastic=False)
+    x = _vec(100)
+    a = codec.roundtrip(x, jax.random.PRNGKey(0))
+    b = codec.roundtrip(x, jax.random.PRNGKey(99))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_quantize_zero_tile_survives():
+    """An all-zero tile must not divide by zero."""
+    codec = QuantizeCodec(bits=8, tile=4)
+    x = jnp.zeros((8,), jnp.float32)
+    out = np.asarray(codec.roundtrip(x, jax.random.PRNGKey(0)))
+    np.testing.assert_array_equal(out, np.zeros(8, np.float32))
+
+
+def test_quantize_wire_bytes_accounting():
+    """bits/8 per element (tile-padded) + one f32 scale per tile."""
+    c8 = QuantizeCodec(bits=8, tile=256)
+    c4 = QuantizeCodec(bits=4, tile=256)
+    assert c8.wire_bytes(1024) == 1024 + 4 * 4.0
+    assert c4.wire_bytes(1024) == 512 + 4 * 4.0
+    # padding: 1025 elements → 5 tiles
+    assert c8.wire_bytes(1025) == 5 * 256 + 5 * 4.0
+
+
+# -- top-k -----------------------------------------------------------------
+
+
+def test_topk_keeps_largest_and_zeroes_rest():
+    codec = TopKCodec(frac=0.1)
+    x = jnp.asarray(np.r_[np.zeros(90), np.arange(1, 11)[::-1]],
+                    jnp.float32)
+    out = np.asarray(codec.roundtrip(x, None))
+    np.testing.assert_array_equal(out, np.asarray(x))  # top-10 IS the mass
+    assert codec.k_of(100) == 10
+
+
+def test_topk_full_frac_is_bit_exact_lossless():
+    """frac >= 1 keeps everything: decompress must be bit-exact."""
+    codec = TopKCodec(frac=1.0)
+    x = _vec(333, seed=5)
+    out = np.asarray(codec.roundtrip(x, None))
+    np.testing.assert_array_equal(out, np.asarray(x))
+
+
+def test_topk_error_feedback_decays_compression_error():
+    """The EF-SGD property (Stich et al. 1809.07599): summing the
+    DELIVERED payloads of a constant signal g under error feedback
+    converges to t·g — the dropped mass re-enters later payloads — while
+    without EF the same sum stays biased forever."""
+    codec = TopKCodec(frac=0.2)
+    g = _vec(50, seed=6)
+
+    def run(ef_on, t_steps=25):
+        residual = jnp.zeros_like(g)
+        delivered = jnp.zeros_like(g)
+        for _ in range(t_steps):
+            send = g + residual if ef_on else g
+            out = codec.roundtrip(send, None)
+            if ef_on:
+                residual = send - out
+            delivered = delivered + out
+        # mean delivered per step vs the true signal
+        return float(jnp.linalg.norm(delivered / t_steps - g))
+
+    err_ef = run(True)
+    err_plain = run(False)
+    assert err_ef < 0.2 * err_plain, (err_ef, err_plain)
+    assert err_ef < 0.1 * float(jnp.linalg.norm(g))
+
+
+def test_topk_wire_bytes_accounting():
+    codec = TopKCodec(frac=0.01)
+    assert codec.wire_bytes(1000) == 10 * 8.0   # int32 idx + f32 val
+    assert codec.wire_bytes(10) == 1 * 8.0      # k >= 1 floor
+
+
+# -- factory / keys --------------------------------------------------------
+
+
+def test_make_codec_dispatch_and_validation():
+    assert make_codec(None).config()["codec"] == "int8"
+    assert make_codec("int4").bits == 4
+    assert make_codec("topk", frac=0.5).frac == 0.5
+    c = QuantizeCodec(bits=8, tile=32)
+    assert make_codec(c) is c
+    with pytest.raises(ValueError, match="unknown codec"):
+        make_codec("zfp")
+    with pytest.raises(ValueError, match="bits must be"):
+        QuantizeCodec(bits=3)
+    with pytest.raises(ValueError, match="frac must be"):
+        TopKCodec(frac=0.0)
+
+
+def test_hop_keys_shared_schedule_host_vs_traced():
+    """The (seed, step) fold must agree between host-concrete and jitted
+    traced step — the agreement-without-communication invariant."""
+    host = hop_keys(7, 3)
+    traced = jax.jit(lambda s: hop_keys(7, s))(jnp.asarray(3, jnp.int32))
+    np.testing.assert_array_equal(np.asarray(host), np.asarray(traced))
+    # distinct per hop and per step
+    assert not np.array_equal(np.asarray(host[0]), np.asarray(host[1]))
+    assert not np.array_equal(np.asarray(hop_keys(7, 4)),
+                              np.asarray(host))
+
+
+def test_quantized_codec_jit_clean():
+    """compress/decompress must trace with no host callbacks — jit the
+    full round-trip and check the result is identical to eager."""
+    codec = QuantizeCodec(bits=8, tile=64)
+    x = _vec(200, seed=8)
+    key = jax.random.PRNGKey(9)
+    eager = codec.roundtrip(x, key)
+    jitted = jax.jit(lambda v, k: codec.roundtrip(v, k))(x, key)
+    np.testing.assert_array_equal(np.asarray(eager), np.asarray(jitted))

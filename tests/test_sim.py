@@ -251,6 +251,132 @@ def test_diloco_participation_trace_prices_alive_group():
     assert events_tx_bytes(evs) == pytest.approx(expect)
 
 
+def test_noloco_trace_gossip_cadence_pairs_and_pricing():
+    """NoLoCo's trace: p2p gossip rounds at the H cadence, per-node tx =
+    |θ| regardless of K, pairs a fixed-point-free permutation matching
+    the host twin — and the cost model prices the round as ONE
+    concurrent exchange on every preset, not a serial K-hop chain."""
+    from gym_tpu.strategy import NoLoCoStrategy
+
+    s = NoLoCoStrategy(H=5)
+    assert s.comm_events(0, PARAMS, 4) == []     # step>0 gate
+    assert s.comm_events(3, PARAMS, 4) == []
+    assert s.comm_events(5, PARAMS, 1) == []     # K=1: no partner
+    for K in (2, 4, 8):
+        evs = s.comm_events(5, PARAMS, K)
+        assert [e.op for e in evs] == ["p2p"]
+        assert evs[0].bytes == PBYTES
+        # ONE |θ| per node per round — the whole point vs all-reduce's
+        # 2(K−1)/K·|θ|
+        assert events_tx_bytes(evs) == PBYTES
+        # pairs are (sender, receiver) of the actual dataflow: node i
+        # reads from σ(i), so the edge is (σ(i), i)
+        src_of = {recv: send for send, recv in evs[0].pairs}
+        assert sorted(src_of) == sorted(src_of.values()) == list(range(K))
+        assert all(i != j for i, j in evs[0].pairs)   # derangement
+        np.testing.assert_array_equal(
+            np.asarray([src_of[i] for i in range(K)]),
+            s.partner_permutation(5, K))
+    # the draw changes every gossip step (fresh mixing matrix)
+    assert s.comm_events(5, PARAMS, 8)[0].pairs \
+        != s.comm_events(10, PARAMS, 8)[0].pairs
+    # pricing: every preset prices the round; a gossip round is one
+    # concurrent p2p hop, so it must cost (far) less than the same
+    # bytes through a K-node ring all-reduce on the same preset
+    for preset in ("wan", "datacenter", "federated"):
+        topo = resolve_topology(preset, 8)
+        ev = s.comm_events(5, PARAMS, 8)[0]
+        t_gossip = collective_time(ev, topo)
+        t_ar = collective_time(CollectiveEvent("all_reduce", PBYTES, 8),
+                               topo)
+        assert 0 < t_gossip < t_ar, preset
+
+
+def test_gossip_round_time_prices_the_links_pairs_cross():
+    """Hierarchical topology: an all-intra-host pairing costs the fast
+    link's single hop; one cross-host pair drags the round to the slow
+    link — the per-edge pricing the `pairs` field exists for."""
+    from gym_tpu.sim.cost_model import gossip_round_time, p2p_time
+
+    intra, inter = Link(4e10, 1e-6), Link(1.25e8, 5e-2)
+    hier = Topology("h", 8, intra=intra, inter=inter, nodes_per_host=4)
+    nbytes = 1e6
+    # nodes 0-3 on host 0, 4-7 on host 1: pair within hosts
+    intra_pairs = ((0, 1), (1, 0), (2, 3), (3, 2),
+                   (4, 5), (5, 4), (6, 7), (7, 6))
+    cross_pairs = ((0, 4), (4, 0), (1, 5), (5, 1),
+                   (2, 6), (6, 2), (3, 7), (7, 3))
+    assert gossip_round_time(nbytes, intra_pairs, hier) \
+        == p2p_time(nbytes, intra)
+    assert gossip_round_time(nbytes, cross_pairs, hier) \
+        == p2p_time(nbytes, inter)
+    # self-pairs (a node sitting out) are free
+    assert gossip_round_time(nbytes, ((0, 0), (1, 1)), hier) == 0.0
+    # the CollectiveEvent path dispatches on pairs
+    ev = CollectiveEvent("p2p", nbytes, 8, pairs=intra_pairs)
+    assert collective_time(ev, hier) == p2p_time(nbytes, intra)
+
+
+def test_dynamiq_trace_prices_compressed_wire_bytes():
+    """DynamiQ's trace declares the codec's honest wire bytes (data +
+    per-tile scales / top-k indices) on the canonical reduce-scatter +
+    all-gather schedule — ~bits/32 of the dense cost, priced on every
+    preset."""
+    from gym_tpu.strategy import DynamiQStrategy, SimpleReduceStrategy
+
+    K = 4
+    dense_tx = events_tx_bytes(
+        SimpleReduceStrategy().comm_events(0, PARAMS, K))
+    for codec, lo, hi in (("int8", 0.25, 0.30), ("int4", 0.125, 0.18)):
+        s = DynamiQStrategy(codec=codec)
+        evs = s.comm_events(0, PARAMS, K)
+        assert [e.op for e in evs] == ["reduce_scatter", "all_gather"]
+        ratio = events_tx_bytes(evs) / dense_tx
+        assert lo <= ratio <= hi, (codec, ratio)
+        assert s.comm_events(0, PARAMS, 1) == []   # K=1: silent
+    # every preset prices the compressed schedule below the dense one
+    s8 = DynamiQStrategy(codec="int8")
+    for preset in ("wan", "datacenter", "federated"):
+        topo = resolve_topology(preset, K)
+        t_c = sum(collective_time(e, topo)
+                  for e in s8.comm_events(0, PARAMS, K))
+        t_d = sum(collective_time(e, topo)
+                  for e in SimpleReduceStrategy().comm_events(0, PARAMS, K))
+        assert 0 < t_c < t_d, preset
+    # top-k: 5% of elements at 8 B each, per hop convention
+    st = DynamiQStrategy(codec="topk", frac=0.05)
+    evs = st.comm_events(0, PARAMS, K)
+    n = 100 * 64 + 64
+    assert evs[0].bytes == st.codec.wire_bytes(n)
+    assert evs[1].bytes == K * st.codec.wire_bytes(-(-n // K))
+
+
+def test_dynamiq_metric_matches_trace_exactly_under_stochastic_rounding():
+    """Sparta-style realized accounting: stochastic rounding randomizes
+    the VALUES on the wire, never the byte count — the jitted step's
+    comm_bytes metric and the host trace must agree exactly at every
+    step, not in expectation."""
+    from gym_tpu.parallel import NodeRuntime
+    from gym_tpu.strategy import DynamiQStrategy
+
+    K, n = 4, 1000
+    s = DynamiQStrategy(optim_spec=OptimSpec("sgd", lr=0.01), codec="int8")
+    s.finalize(10)
+    rt = NodeRuntime.create(K, None)
+    s.bind_ctx(rt.ctx)
+    params = rt.shard_batch({"w": np.ones((K, n), np.float32)})
+    state = rt.compile(lambda p: s.init(p), donate_state=False)(params)
+    raw = rt.compile(lambda p, st, g, t: s.step(g, p, st, t, rt.ctx),
+                     donate_state=False)
+    template = {"w": jax.ShapeDtypeStruct((n,), np.float32)}
+    for t in (0, 3):
+        tvec = rt.shard_batch(np.full(K, t, np.int32))
+        _, _, m = raw(params, state, params, tvec)
+        metric = float(np.asarray(m["comm_bytes"])[0])
+        trace = events_tx_bytes(s.comm_events(t, template, K))
+        assert trace == pytest.approx(metric, rel=1e-6), t
+
+
 # -- simulator -------------------------------------------------------------
 
 
@@ -289,10 +415,29 @@ def test_simulator_diloco_beats_allreduce_on_wan_not_datacenter():
 # -- reconciliation against a real fit (the ISSUE 3 acceptance oracle) -----
 
 
+def _noloco():
+    from gym_tpu.strategy import NoLoCoStrategy
+    return NoLoCoStrategy(optim_spec=OptimSpec("adamw", lr=1e-3), H=7)
+
+
+def _dynamiq():
+    from gym_tpu.strategy import DynamiQStrategy
+    return DynamiQStrategy(optim_spec=OptimSpec("adamw", lr=1e-3),
+                           codec="int8")
+
+
+def _dynamiq_topk():
+    from gym_tpu.strategy import DynamiQStrategy
+    return DynamiQStrategy(optim_spec=OptimSpec("adamw", lr=1e-3),
+                           codec="topk", frac=0.05)
+
+
 @pytest.mark.parametrize("strategy_fn", [
     lambda: SimpleReduceStrategy(optim_spec=OptimSpec("adamw", lr=1e-3)),
     lambda: DiLoCoStrategy(optim_spec=OptimSpec("adamw", lr=1e-3), H=7),
-], ids=["simple_reduce", "diloco"])
+    _noloco, _dynamiq, _dynamiq_topk,
+], ids=["simple_reduce", "diloco", "noloco", "dynamiq_int8",
+        "dynamiq_topk"])
 def test_trace_reconciles_with_cum_comm_bytes_30_step_fit(
         strategy_fn, tmp_path):
     """Trace totals vs the logged cum_comm_bytes column on a REAL 30-step

@@ -355,3 +355,189 @@ def test_diloco_shard_outer_matches_replicated():
     # 3(K-1)/K·|θ| vs the replicated 2(K-1)/K·|θ| (26 f32 params = 104 B)
     assert comm_rep == 2.0 * 3 / 4 * 104
     assert comm_sh == 3.0 * 3 / 4 * 104
+
+
+def test_noloco_gossip_preserves_node_mean_and_matches_host_twin():
+    """One NoLoCo gossip round with a pass-through outer step (SGD
+    lr=1.0, no momentum): params_i ← (p_i + p_σ(i))/2 with σ the host
+    twin's permutation, so the NODE-MEAN of the params is preserved
+    exactly (doubly-stochastic mixing) while nodes move toward pairwise
+    consensus — and zero inner lr isolates the gossip itself."""
+    from gym_tpu.strategy import NoLoCoStrategy
+
+    K, H = 4, 2
+    rng = np.random.default_rng(11)
+    w0 = {"w": rng.normal(size=(K, 5)).astype(np.float32)}
+    zeros = {"w": np.zeros((K, 5), np.float32)}
+    strat = NoLoCoStrategy(
+        optim_spec=OptimSpec("sgd", lr=0.0),
+        outer_optim_spec=OptimSpec("sgd", lr=1.0, momentum=0.0,
+                                   nesterov=False),
+        H=H)
+    rt, step_fn, params, state = make_harness(strat, K, w0)
+    before = jax.device_get(params)["w"].copy()
+
+    params, state, m = step_fn(params, state, zeros, 1)   # off-cadence
+    np.testing.assert_allclose(jax.device_get(params)["w"], before,
+                               atol=1e-7)
+    assert np.all(m["comm_bytes"] == 0.0)
+
+    params, state, m = step_fn(params, state, zeros, H)   # gossip round
+    after = jax.device_get(params)["w"]
+    sigma = strat.partner_permutation(H, K)
+    assert sorted(sigma) == list(range(K))
+    assert np.all(sigma != np.arange(K))                  # derangement
+    for i in range(K):
+        np.testing.assert_allclose(
+            after[i], 0.5 * (before[i] + before[sigma[i]]),
+            atol=1e-6, rtol=1e-5)
+    # doubly-stochastic mixing: the fleet mean is invariant
+    np.testing.assert_allclose(after.mean(axis=0), before.mean(axis=0),
+                               atol=1e-6, rtol=1e-5)
+    # p2p accounting: |θ| per node (5 f32 = 20 B), NOT 2(K−1)/K·|θ|
+    assert np.all(m["comm_bytes"] == 20.0)
+
+
+def test_noloco_consensus_emerges_over_rounds():
+    """Repeated partner averaging with fresh random cycles contracts the
+    node spread: after a few rounds every node is near the (preserved)
+    fleet mean even though no global collective ever ran."""
+    from gym_tpu.strategy import NoLoCoStrategy
+
+    K = 8
+    rng = np.random.default_rng(12)
+    w0 = {"w": rng.normal(size=(K, 3)).astype(np.float32)}
+    zeros = {"w": np.zeros((K, 3), np.float32)}
+    strat = NoLoCoStrategy(
+        optim_spec=OptimSpec("sgd", lr=0.0),
+        outer_optim_spec=OptimSpec("sgd", lr=1.0, momentum=0.0,
+                                   nesterov=False),
+        H=1)
+    rt, step_fn, params, state = make_harness(strat, K, w0)
+    spread0 = jax.device_get(params)["w"].std(axis=0).max()
+    for t in range(1, 13):
+        params, state, _ = step_fn(params, state, zeros, t)
+    after = jax.device_get(params)["w"]
+    np.testing.assert_allclose(after.mean(axis=0),
+                               w0["w"].mean(axis=0), atol=1e-5)
+    assert after.std(axis=0).max() < 0.05 * spread0
+
+
+def test_dynamiq_canonical_matches_vnode_schedule():
+    """DynamiQ's two emulation schedules (psum_scatter + all_gather on a
+    pure node mesh; pmean + slice under vnode folding) apply the SAME
+    shared-PRNG codec noise to the same values — identical params, and
+    the comm_bytes metric reports the CANONICAL compressed wire cost
+    either way."""
+    from gym_tpu.strategy import DynamiQStrategy
+
+    K = 4
+    rng = np.random.default_rng(13)
+    w0 = {"w": np.repeat(rng.normal(size=(1, 7, 3)).astype(np.float32),
+                         K, axis=0),
+          "b": np.repeat(rng.normal(size=(1, 5)).astype(np.float32),
+                         K, axis=0)}
+
+    def run(n_devices):
+        strat = DynamiQStrategy(optim_spec=OptimSpec("adamw", lr=1e-2),
+                                codec="int8", tile=16)
+        rt, step_fn, params, state = make_harness(
+            strat, K, w0, devices=jax.devices()[:n_devices])
+        assert (rt.n_virt == 1) == (n_devices == K)
+        rng_g = np.random.default_rng(14)
+        comm = None
+        for t in range(3):
+            g = {"w": rng_g.normal(size=(K, 7, 3)).astype(np.float32),
+                 "b": rng_g.normal(size=(K, 5)).astype(np.float32)}
+            params, state, m = step_fn(params, state, g, t)
+            comm = float(np.asarray(m["comm_bytes"]).ravel()[0])
+        return jax.device_get(params), strat, comm
+
+    p_can, strat, c_can = run(K)      # n_virt=1 → reduce-scatter
+    p_vn, _, c_vn = run(K // 2)       # n_virt=2 → pmean+slice fallback
+    for key in ("w", "b"):
+        np.testing.assert_allclose(p_can[key], p_vn[key],
+                                   atol=1e-6, rtol=1e-5)
+    # both account the canonical compressed schedule: (K−1)/K·(w1+w2)
+    w1, w2 = strat._wires(26, K)
+    assert c_can == c_vn == pytest.approx(3 / 4 * (w1 + w2))
+
+
+def test_dynamiq_quantized_step_approximates_dense_allreduce():
+    """int8 stochastic rounding perturbs the gradient by at most one
+    quantization bin per hop: a DynamiQ step must land within a few bins
+    of the exact SimpleReduce step on the same grads (and K=1 must be
+    EXACTLY the dense update — nothing on the wire, nothing to
+    compress)."""
+    from gym_tpu.strategy import DynamiQStrategy
+
+    K = 4
+    w0 = {"w": np.zeros((K, 40), np.float32)}
+    rng = np.random.default_rng(15)
+    g = {"w": np.repeat(rng.normal(size=(1, 40)).astype(np.float32),
+                        K, axis=0)}
+
+    def run(strat_cls, **kw):
+        strat = strat_cls(optim_spec=OptimSpec("sgd", lr=1.0), **kw)
+        rt, step_fn, params, state = make_harness(strat, K, w0)
+        params, state, m = step_fn(params, state, g, 0)
+        return jax.device_get(params)["w"]
+
+    p_dense = run(SimpleReduceStrategy)
+    p_q = run(DynamiQStrategy, codec="int8", tile=64)
+    bin_size = np.abs(g["w"][0]).max() / 127
+    assert np.abs(p_q - p_dense).max() <= 2.5 * bin_size
+    # node-identical output: every node decompresses the same payloads
+    for k in range(1, K):
+        np.testing.assert_array_equal(p_q[k], p_q[0])
+
+    # K=1: bit-exact dense update
+    w1 = {"w": np.zeros((1, 40), np.float32)}
+    g1 = {"w": g["w"][:1]}
+    strat = DynamiQStrategy(optim_spec=OptimSpec("sgd", lr=1.0),
+                            codec="int8")
+    rt, step_fn, params, state = make_harness(strat, 1, w1)
+    params, state, m = step_fn(params, state, g1, 0)
+    np.testing.assert_array_equal(jax.device_get(params)["w"],
+                                  -g1["w"])
+    assert np.all(m["comm_bytes"] == 0.0)
+
+
+def test_dynamiq_error_feedback_conserves_dropped_mass_exactly():
+    """Top-k with double error feedback: nothing is ever lost — summing
+    the delivered updates of a constant gradient g over T steps gives
+    EXACTLY T·g minus what the residuals still hold (hop 1: mean over
+    nodes; hop 2: each node's own-chunk residual), the EF-SGD
+    conservation law. SGD lr=1 makes the delivered sum directly
+    observable as −params."""
+    from gym_tpu.strategy import DynamiQStrategy
+
+    K, n = 4, 40
+    shard = n // K
+    w0 = {"w": np.zeros((K, n), np.float32)}
+    rng = np.random.default_rng(16)
+    g = {"w": np.repeat(rng.normal(size=(1, n)).astype(np.float32),
+                        K, axis=0)}
+    strat = DynamiQStrategy(optim_spec=OptimSpec("sgd", lr=1.0),
+                            codec="topk", frac=0.1)
+    rt, step_fn, params, state = make_harness(strat, K, w0)
+    T = 12
+    for t in range(T):
+        params, state, m = step_fn(params, state, g, t)
+    final = jax.device_get(params)["w"]
+    st = jax.device_get(state)
+    # the residuals really are training state, carried across steps
+    assert st["residual"].shape == (K, n) and np.any(st["residual"] != 0)
+    assert st["residual2"].shape == (K, shard)
+    # conservation: delivered = T·g − mean_i r_i − r2[chunk owner]
+    # (node j owns chunk j, so row j of residual2 assembles in order)
+    undelivered = (st["residual"].mean(axis=0)
+                   + st["residual2"].reshape(-1))
+    np.testing.assert_allclose(-final[0], T * g["w"][0] - undelivered,
+                               rtol=1e-4, atol=1e-4)
+    # and the delivered sum is genuinely converging on T·g: the lag is
+    # bounded by what the residuals hold, not growing with T
+    assert np.abs(undelivered).max() < T * np.abs(g["w"][0]).max()
+    # all nodes decompress the same gathered payloads → identical params
+    for k in range(1, K):
+        np.testing.assert_array_equal(final[k], final[0])

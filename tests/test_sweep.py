@@ -124,3 +124,90 @@ def test_sweep_end_to_end_and_resume(tmp_path):
     assert os.path.getmtime(marker) == mtime
     assert {r["strategy"] for r in rows3} \
         == {"diloco", "simple_reduce", "fedavg"}
+
+
+def test_grid_bits_axis_multiplies_only_compressed_strategies(tmp_path):
+    cfg = _cfg(tmp_path, strategies=["dynamiq", "noloco", "simple_reduce"],
+               presets=["wan"], H=[4, 8], bits=[8, 4])
+    cells = grid(cfg)
+    # dynamiq × 2 bits, noloco × 2 H, simple_reduce once
+    assert len(cells) == 2 + 2 + 1
+    assert Cell("dynamiq", None, 2, "wan", 8) in cells
+    assert Cell("dynamiq", None, 2, "wan", 4) in cells
+    assert Cell("noloco", 4, 2, "wan") in cells
+    assert Cell("dynamiq", None, 2, "wan", 8).cell_id \
+        == "dynamiq_int8_n2_wan"
+    assert Cell("noloco", 4, 2, "wan").cell_id == "noloco_H4_n2_wan"
+    # the headline alias resolves AND pins its named bit-width — --bits
+    # cannot silently override what the alias says
+    cfg8 = _cfg(tmp_path, strategies=["dynamiq_int8"], presets=["wan"],
+                bits=[4])
+    assert cfg8.strategies == ["dynamiq"]
+    assert [c.bits for c in grid(cfg8)] == [8]
+    # a cell requested both ways runs once
+    cfg_dup = _cfg(tmp_path, strategies=["dynamiq", "dynamiq_int8"],
+                   presets=["wan"], bits=[8])
+    assert len(grid(cfg_dup)) == 1
+    with pytest.raises(ValueError, match="unknown bit-width"):
+        _cfg(tmp_path, bits=[16])
+
+
+def test_pareto_frontier_verdicts_and_csv(tmp_path):
+    """The frontier artifact: dominated configs are OFF, the loss/time
+    trade survives (a slower-but-better-loss config stays ON), and
+    frontier.csv carries one verdict row per cell."""
+    from gym_tpu.sim.sweep import pareto_frontier, write_frontier_csv
+
+    def row(cfg_name, t, loss, **kw):
+        r = {"strategy": cfg_name, "H": None, "bits": None,
+             "topology": "wan", "nodes": 4, "sim_total_s": t,
+             "sim_comm_s": t / 2, "final_train_loss": loss,
+             "cum_comm_bytes": 1e6}
+        r.update(kw)
+        return r
+
+    fast_bad = row("noloco", 1.0, 3.0)
+    slow_good = row("simple_reduce", 10.0, 2.0)
+    mid_dominated = row("fedavg", 10.0, 3.0)     # worse than both axes
+    mid_ok = row("dynamiq", 5.0, 2.5, bits=8)
+    diverged = row("sparta", 0.5, float("nan"))  # fastest but NaN loss
+    rows = [slow_good, fast_bad, mid_dominated, mid_ok, diverged]
+    front = pareto_frontier(rows)
+    assert [r["strategy"] for r in front] \
+        == ["noloco", "dynamiq", "simple_reduce"]   # sorted by time
+    assert mid_dominated not in front
+    # a diverged cell is never "Pareto-optimal" (NaN compares False
+    # against everything and would otherwise be undominatable)
+    assert diverged not in front
+
+    path = str(tmp_path / "frontier.csv")
+    write_frontier_csv(path, rows)
+    with open(path, newline="") as f:
+        got = {r["config"]: r for r in csv.DictReader(f)}
+    assert len(got) == 5
+    assert got["fedavg"]["on_frontier"] == "False"
+    assert got["sparta"]["on_frontier"] == "False"   # diverged
+    assert got["dynamiq int8"]["on_frontier"] == "True"
+    assert float(got["noloco"]["sim_total_s"]) == 1.0
+
+
+def test_sweep_with_low_comm_strategies_end_to_end(tmp_path):
+    """noloco + dynamiq through the full sweep runner: cells run,
+    reconcile at runtime, and the report + frontier artifacts include
+    them."""
+    cfg = _cfg(tmp_path, strategies=["noloco", "dynamiq_int8"],
+               presets=["wan"], H=[3])
+    rows = run_sweep(cfg)
+    assert len(rows) == 2
+    assert all(r["reconciled"] for r in rows), rows
+    by = {r["strategy"]: r for r in rows}
+    assert by["dynamiq"]["bits"] == 8
+    assert by["noloco"]["H"] == 3
+    # gossip's per-node traffic is below the compressed all-reduce's
+    assert by["noloco"]["cum_comm_bytes"] < by["dynamiq"]["cum_comm_bytes"]
+    with open(os.path.join(cfg.out, "frontier.csv"), newline="") as f:
+        verdicts = list(csv.DictReader(f))
+    assert {v["config"] for v in verdicts} == {"noloco H=3", "dynamiq int8"}
+    with open(os.path.join(cfg.out, "report.md")) as f:
+        report = f.read()
+    assert "Pareto frontier" in report
