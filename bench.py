@@ -569,6 +569,8 @@ def measure_serving() -> dict:
     here (per-row attention over the static cache dominates; there is no
     under-utilized MXU to fill), so batching pays modestly; on an
     accelerator the batch dimension is where the win scales."""
+    import math
+
     import numpy as np
 
     from gym_tpu.models.nanogpt import GPT, GPTConfig, generate_fast
@@ -582,6 +584,7 @@ def measure_serving() -> dict:
                     n_embd=128, dropout=0.0, bias=True)
     model = GPT(cfg)
     import jax
+    import jax.numpy as jnp
     params = model.init({"params": jax.random.PRNGKey(0)},
                         np.zeros((1, 8), np.int64), train=False)["params"]
 
@@ -648,9 +651,14 @@ def measure_serving() -> dict:
         for i in range(n_shared)]
     shared_new = sum(sp.max_new_tokens for _, sp in shared_workload)
 
-    def shared_arm(paged: bool, spec: int = 0) -> dict:
+    def shared_arm(paged: bool, spec: int = 0, arm_cfg=None,
+                   arm_params=None) -> dict:
+        arm_cfg = cfg if arm_cfg is None else arm_cfg
+        arm_params = params if arm_params is None else arm_params
+
         def mk():
-            return InferenceEngine(params, cfg, num_slots=num_slots,
+            return InferenceEngine(arm_params, arm_cfg,
+                                   num_slots=num_slots,
                                    decode_chunk=chunk, paged=paged,
                                    page_size=16, spec_tokens=spec)
 
@@ -694,6 +702,148 @@ def measure_serving() -> dict:
         paged_arm, pr4_arm)
     assert paged_arm["prefix_hit_blocks"] > 0, paged_arm
 
+    # ---- quantized serving (ISSUE 11): int8 weights + int8 paged KV.
+    # The HEADLINE here is the deterministic capacity metric — resident
+    # shared prefix blocks at a fixed KV payload byte budget — plus the
+    # prefill-work elision it buys; tok/s is reported next to it but on
+    # this 2-core CPU box it is noise-prone (±10%, see BENCH_r06) and
+    # carries its own status field.
+    import dataclasses as _dc
+
+    from gym_tpu.serve.load import quantize_params
+
+    qcfg = _dc.replace(cfg, weights_dtype="int8", kv_dtype="int8")
+    qparams = quantize_params(params, qcfg)
+    f32_param_bytes = sum(int(x.size * x.dtype.itemsize)
+                          for x in jax.tree.leaves(params))
+    q_param_bytes = sum(int(np.asarray(x).nbytes)
+                        for x in jax.tree.leaves(qparams))
+
+    def capacity_arm(arm_cfg, arm_params, kv_pages: int):
+        """Sequential distinct one-block prompts through a small pool:
+        every request content-registers its prompt block; the resident
+        (refcount-0 cached) block count at the end IS the pool's
+        prefix-holding capacity — deterministic, no timing anywhere."""
+        eng = InferenceEngine(arm_params, arm_cfg, num_slots=2,
+                              paged=True, page_size=16,
+                              kv_pages=kv_pages)
+        for i in range(80):
+            slot, ev = eng.admit(
+                rng.integers(0, cfg.vocab_size, 16),
+                SamplingParams(max_new_tokens=2, seed=900 + i))
+            while not ev.finished:
+                evs = [e for e in eng.step() if e.slot == slot]
+                ev = evs[-1]
+        return eng
+
+    # smallest legal f32 pool (null + one full window + CoW headroom);
+    # the int8 arm gets exactly the same PAYLOAD byte budget — 4 pages
+    # per f32 page — and must hold >= 4x the resident prefixes
+    f32_kv_pages = 2 + cfg.block_size // 16           # 18 → 17 usable
+    int8_kv_pages = 1 + (f32_kv_pages - 1) * 4        # 69: equal payload
+    cap_f32 = capacity_arm(cfg, params, f32_kv_pages)
+    cap_int8 = capacity_arm(qcfg, qparams, int8_kv_pages)
+    # structural acceptance (ISSUE 11): the int8 pool's PAYLOAD fits the
+    # f32 byte budget (scale sidecar reported, not hidden) and holds
+    # >= 4x the resident prefix blocks
+    assert (cap_int8.kv_pool_bytes()["payload"]
+            <= cap_f32.kv_pool_bytes()["payload"]), (
+        cap_int8.kv_pool_bytes(), cap_f32.kv_pool_bytes())
+    assert (cap_int8.stats.kv_blocks_cached
+            >= 4 * cap_f32.stats.kv_blocks_cached), (
+        cap_int8.stats.kv_blocks_cached, cap_f32.stats.kv_blocks_cached)
+
+    # token-stream divergence vs f32, per sampling config (int8 streams
+    # are exact vs their own quantized reference — pinned in
+    # tests/test_serve_paged.py — so what is measured here is the honest
+    # f32-vs-int8 QUALITY delta, not a correctness bug)
+    div_prompt = rng.integers(0, cfg.vocab_size, 24)
+    div_new = 32
+    divergence = {}
+    for name, kw in (("greedy", dict(top_k=1)),
+                     ("temp0.9_topk16", dict(temperature=0.9, top_k=16)),
+                     ("topp0.9", dict(top_p=0.9))):
+        ref = generate_fast(params, cfg, div_prompt[None], div_new,
+                            seed=7, **kw)[0, 24:]
+        got = generate_fast(qparams, qcfg, div_prompt[None], div_new,
+                            seed=7, **kw)[0, 24:]
+        diff = np.asarray(ref) != np.asarray(got)
+        first = int(np.argmax(diff)) if diff.any() else None
+        divergence[name] = {
+            "tokens": div_new,
+            "diverged_frac": round(float(diff.mean()), 4),
+            "first_divergence_index": first,
+        }
+
+    # perplexity delta: mean CE of the SAME forward under f32 vs
+    # quantized weights (eval mode; random-init model, so the absolute
+    # level is meaningless — the DELTA is the codec's quality cost)
+    ev = rng.integers(0, cfg.vocab_size, (4, 65))
+    ev_batch = (jnp.asarray(ev[:, :-1]), jnp.asarray(ev[:, 1:]))
+    loss_f32 = float(GPT(cfg).apply({"params": params}, ev_batch,
+                                    train=False))
+    loss_q = float(GPT(qcfg).apply({"params": qparams}, ev_batch,
+                                   train=False))
+
+    # tok/s: the shared-prefix workload on the quantized engine (weights
+    # dequant fused into the matmuls + int8 KV), vs the f32 paged arm
+    quant_arm = shared_arm(paged=True, arm_cfg=qcfg, arm_params=qparams)
+
+    capacity_ratio = round(cap_int8.stats.kv_blocks_cached
+                           / max(cap_f32.stats.kv_blocks_cached, 1), 2)
+    quantized = {
+        # self-describing artifact: --compare'able on the DETERMINISTIC
+        # capacity ratio (write {"parsed": {"quantized": ...}} wrappers
+        # and two rounds compare cleanly; tok/s stays a side column)
+        "metric": "quantized_serving_capacity_ratio_int8_vs_f32",
+        "value": capacity_ratio,
+        "status": "measured",
+        "measured": True,
+        "config": "weights int8 (per-tile codec, dequant fused) + "
+                  "kv int8 (per-(page-slot, head) scales); embedding "
+                  "f32",
+        "weights_bytes_f32": f32_param_bytes,
+        "weights_bytes_int8": q_param_bytes,
+        "weights_bytes_ratio": round(f32_param_bytes
+                                     / max(q_param_bytes, 1), 2),
+        "capacity": {
+            # the deterministic headline: resident shared prefixes at a
+            # FIXED KV payload byte budget (18-page f32 pool vs 69-page
+            # int8 pool — equal payload bytes; no timing anywhere)
+            "workload": "80 distinct 1-block prompts, page 16, "
+                        "sequential",
+            "f32_kv_pages": f32_kv_pages,
+            "int8_kv_pages": int8_kv_pages,
+            "f32_pool_bytes": cap_f32.kv_pool_bytes(),
+            "int8_pool_bytes": cap_int8.kv_pool_bytes(),
+            "f32_resident_prefix_blocks":
+                int(cap_f32.stats.kv_blocks_cached),
+            "int8_resident_prefix_blocks":
+                int(cap_int8.stats.kv_blocks_cached),
+            "capacity_ratio": capacity_ratio,
+            "prefill_tokens_f32_arm": int(cap_f32.stats.prefill_tokens),
+            "prefill_tokens_int8_arm":
+                int(cap_int8.stats.prefill_tokens),
+        },
+        "shared_prefix_quantized": quant_arm,
+        "tok_s_vs_f32_paged": round(
+            quant_arm["tok_s"] / max(paged_arm["tok_s"], 1e-9), 2),
+        "tok_s_note": "2-core CPU box: tok/s drifts +-10% — the "
+                      "capacity metric above is the headline; on an "
+                      "accelerator the int8 weight traffic is where "
+                      "dequant-fused matmuls win",
+        "divergence_vs_f32": divergence,
+        "quality": {
+            "eval_loss_f32": round(loss_f32, 6),
+            "eval_loss_int8": round(loss_q, 6),
+            "loss_delta": round(loss_q - loss_f32, 6),
+            "perplexity_f32": round(math.exp(loss_f32), 4),
+            "perplexity_int8": round(math.exp(loss_q), 4),
+            "perplexity_delta": round(math.exp(loss_q)
+                                      - math.exp(loss_f32), 4),
+        },
+    }
+
     return {
         "metric": "serving_continuous_batching_vs_sequential_tokens_per_s",
         "status": "measured",
@@ -729,6 +879,7 @@ def measure_serving() -> dict:
             "prefill_tokens_elided": (pr4_arm["prefill_tokens"]
                                       - paged_arm["prefill_tokens"]),
         },
+        "quantized": quantized,
     }
 
 

@@ -291,6 +291,42 @@ def engine_program_defs(num_slots: int = 2, decode_chunk: int = 4,
                                    decode_chunk=decode_chunk,
                                    buckets=buckets, page_size=page_size,
                                    gamma=gamma))
+    defs.extend(quantized_program_defs(num_slots=num_slots,
+                                       decode_chunk=decode_chunk,
+                                       buckets=buckets,
+                                       page_size=page_size, gamma=gamma))
+    return defs
+
+
+def quantized_program_defs(num_slots: int = 2, decode_chunk: int = 4,
+                           buckets: Sequence[int] = (8, 32),
+                           page_size: int = 8, gamma: int = 4):
+    """The quantized serving family (ISSUE 11) at the audit
+    parameterization: int8 weights (per-tile QuantizeCodec storage with
+    dequant fused into the consuming matmuls) + int8 paged KV (per-(page
+    slot, head) scales). Same paged program set — prefix-aware prefill,
+    CoW page copy, paged decode, fused draft+verify — over the quantized
+    config, so donation discipline (the int8 pools AND their scale
+    sidecars alias through every dispatch), callback freedom and f64
+    hygiene are CI-gated for the quantized hot path exactly like the f32
+    one. Names carry the dtype tag (``serve_defs._qtag``); keys differ
+    from the f32 family through the config tuple."""
+    import dataclasses as _dc
+
+    from ..models.nanogpt import decode_config
+    from ..programs import serve_defs as sd
+
+    base = decode_config(_tiny_gpt_config())
+    mb = base.block_size // page_size
+    kv_pages = 2 + num_slots * mb
+    cfg_tuple = _dc.astuple(
+        _dc.replace(base, page_size=page_size, kv_pages=kv_pages,
+                    weights_dtype="int8", kv_dtype="int8"))
+    defs = [sd.paged_prefill_def(cfg_tuple, int(b)) for b in buckets]
+    defs.append(sd.cow_def(cfg_tuple))
+    defs.append(sd.paged_decode_def(cfg_tuple, num_slots, decode_chunk))
+    defs.append(sd.spec_decode_def(cfg_tuple, num_slots, decode_chunk,
+                                   gamma))
     return defs
 
 

@@ -98,6 +98,23 @@ class GPTConfig:
     # deactivated/overflowing rows are redirected there and never read.
     page_size: int = 0
     kv_pages: int = 0
+    # Quantized serving (ISSUE 11; inference-only — training always runs
+    # f32 params). weights_dtype 'int8'/'int4' stores every block Dense
+    # kernel as per-tile int8 + f32 scales (the strategy/compress.py
+    # QuantizeCodec tiling, quantized at checkpoint load by
+    # serve/load.py:quantize_params) with the dequant fused into the
+    # consuming matmul (ops/grouped_matmul.py:quantized_dot).
+    # quant_embed extends that to the tied wte embedding/lm_head —
+    # SEPARATELY gated because the embedding dominates quality (default
+    # f32). kv_dtype 'int8' makes the decode KV caches/page pools
+    # int8-storable with a per-(page-slot, head) scale
+    # (ops/fused_attention.py:kv_quantize) — same kv_pages budget, 4x
+    # the resident payload. quant_tile is the requested codec tile
+    # (clamped per-leaf to divide the trailing axis; quant_tile_for).
+    weights_dtype: str = "f32"
+    kv_dtype: str = "f32"
+    quant_tile: int = 256
+    quant_embed: bool = False
 
     def is_moe_layer(self, i: int) -> bool:
         return self.n_experts > 0 and i % self.moe_every == self.moe_every - 1
@@ -145,6 +162,98 @@ def _init_normal(std: float):
     return nn.initializers.normal(stddev=std)
 
 
+class QuantDense(nn.Module):
+    """Dense layer over a per-tile-quantized kernel: params are
+    ``qkernel`` (int8, the kernel's own [in, out] shape — int4 values
+    are stored in int8, the 4-bit pack being a wire-format detail) and
+    ``qscale`` (f32, one scale per ``tile`` consecutive flat elements,
+    the QuantizeCodec tiling). The dequant is fused into the consuming
+    matmul (``ops/grouped_matmul.py:quantized_dot``) — no f32 kernel is
+    ever stored. Param trees are produced by
+    ``serve/load.py:quantize_params`` from an f32 checkpoint; the zero/
+    one initializers below exist only so ``init``/``eval_shape`` yield
+    the right templates."""
+
+    features: int
+    tile: int
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        from ..ops.grouped_matmul import quant_tile_for, quantized_dot
+        in_f = x.shape[-1]
+        t = quant_tile_for((in_f, self.features), self.tile)
+        q = self.param("qkernel", nn.initializers.zeros,
+                       (in_f, self.features), jnp.int8)
+        scale = self.param("qscale", nn.initializers.ones,
+                           (in_f * self.features // t,), jnp.float32)
+        y = quantized_dot(x, q, scale)
+        if self.use_bias:
+            y = y + self.param("bias", nn.initializers.zeros,
+                               (self.features,), jnp.float32)
+        return y
+
+
+class QuantEmbed(nn.Module):
+    """Tied-embedding twin of :class:`QuantDense` for the ``wte``
+    table when ``quant_embed`` is on: ``qembedding`` (int8 [V, C]) +
+    ``qscale`` (f32, tiles within rows — ``quant_tile_for`` clamps the
+    tile to divide C, so a row's scales never straddle tokens and the
+    gather dequantizes only the looked-up rows). ``attend`` is the
+    lm_head (logits against the dequantized table, fused)."""
+
+    num_embeddings: int
+    features: int
+    tile: int
+
+    def setup(self):
+        from ..ops.grouped_matmul import quant_tile_for
+        self._t = quant_tile_for((self.num_embeddings, self.features),
+                                 self.tile)
+        self.qembedding = self.param(
+            "qembedding", nn.initializers.zeros,
+            (self.num_embeddings, self.features), jnp.int8)
+        self.qscale = self.param(
+            "qscale", nn.initializers.ones,
+            (self.num_embeddings * self.features // self._t,),
+            jnp.float32)
+
+    def materialize(self, dtype=jnp.float32):
+        """The dequantized [V, C] table — only for consumers that
+        genuinely need the full matrix (the eval CE path); the hot-path
+        lookups below never call it."""
+        from ..ops.grouped_matmul import dequantize_tiles
+        return dequantize_tiles(self.qembedding, self.qscale, dtype)
+
+    def __call__(self, idx):
+        # gather rows of q AND their row-local scales, dequantize only
+        # what was looked up
+        rows_q = jnp.take(self.qembedding, idx, axis=0)
+        sc = self.qscale.reshape(self.num_embeddings,
+                                 self.features // self._t)
+        rows_s = jnp.take(sc, idx, axis=0)
+        return (rows_q.astype(jnp.float32)
+                .reshape(*rows_q.shape[:-1], -1, self._t)
+                * rows_s[..., None]).reshape(rows_q.shape)
+
+    def attend(self, x):
+        from ..ops.grouped_matmul import quantized_attend
+        return quantized_attend(x.astype(jnp.float32), self.qembedding,
+                                self.qscale)
+
+
+def _proj(cfg: GPTConfig, features: int, std: float, name: str):
+    """Block projection dispatch: plain ``nn.Dense`` at f32 (byte-stable
+    default), :class:`QuantDense` under a quantized serving config —
+    SAME module name either way, so the quantized param tree is the f32
+    tree with each kernel leaf swapped for (qkernel, qscale) in place."""
+    if cfg.weights_dtype != "f32":
+        return QuantDense(features=features, tile=cfg.quant_tile,
+                          use_bias=cfg.bias, name=name)
+    return nn.Dense(features, use_bias=cfg.bias,
+                    kernel_init=_init_normal(std), name=name)
+
+
 class CausalSelfAttention(nn.Module):
     config: GPTConfig
 
@@ -156,8 +265,7 @@ class CausalSelfAttention(nn.Module):
             raise ValueError(
                 f"n_embd {c} not divisible by n_head {cfg.n_head}")
         hd = c // cfg.n_head
-        qkv = nn.Dense(3 * c, use_bias=cfg.bias,
-                       kernel_init=_init_normal(0.02), name="c_attn")(x)
+        qkv = _proj(cfg, 3 * c, 0.02, "c_attn")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         if cfg.decode:
@@ -166,10 +274,8 @@ class CausalSelfAttention(nn.Module):
                                               block_table, cache_pos)
             else:
                 y = self._decode_attend(q, k, v, b, t, hd)
-            y = nn.Dense(c, use_bias=cfg.bias,
-                         kernel_init=_init_normal(
-                             0.02 / math.sqrt(2 * cfg.n_layer)),
-                         name="c_proj")(y)
+            y = _proj(cfg, c, 0.02 / math.sqrt(2 * cfg.n_layer),
+                      "c_proj")(y)
             return y
 
         drop_active = train and cfg.dropout > 0
@@ -194,9 +300,7 @@ class CausalSelfAttention(nn.Module):
             )
             y = y.transpose(0, 2, 1, 3).reshape(b, t, c)
         # residual projection: scaled init per GPT-2 paper (reference :213-217)
-        y = nn.Dense(c, use_bias=cfg.bias,
-                     kernel_init=_init_normal(0.02 / math.sqrt(2 * cfg.n_layer)),
-                     name="c_proj")(y)
+        y = _proj(cfg, c, 0.02 / math.sqrt(2 * cfg.n_layer), "c_proj")(y)
         y = nn.Dropout(cfg.dropout, deterministic=not train)(y)
         return y
 
@@ -216,15 +320,17 @@ class CausalSelfAttention(nn.Module):
         prefillled slot row into the cache and rewinds its cursor)."""
         cfg = self.config
         H, S = cfg.n_head, cfg.block_size
+        quant = cfg.kv_dtype == "int8"
 
         def heads(z):
             return z.reshape(b, t, H, hd)
 
         q, k, v = heads(q), heads(k), heads(v)
+        kv_dt = jnp.int8 if quant else q.dtype
         ck = self.variable("cache", "k",
-                           lambda: jnp.zeros((b, S, H, hd), q.dtype))
+                           lambda: jnp.zeros((b, S, H, hd), kv_dt))
         cv = self.variable("cache", "v",
-                           lambda: jnp.zeros((b, S, H, hd), q.dtype))
+                           lambda: jnp.zeros((b, S, H, hd), kv_dt))
         ci = self.variable("cache", "i",
                            lambda: jnp.zeros((b,), jnp.int32))
         i = ci.value                                    # [b] per-row cursor
@@ -233,9 +339,31 @@ class CausalSelfAttention(nn.Module):
         # overflow writes are clamped in-bounds (the scatter would silently
         # drop them; clamping keeps it deterministic) — the row's output is
         # poisoned below either way
-        k_all = ck.value.at[rows, jnp.minimum(wpos, S - 1)].set(k)
-        v_all = cv.value.at[rows, jnp.minimum(wpos, S - 1)].set(v)
-        ck.value, cv.value, ci.value = k_all, v_all, i + t
+        wclamp = jnp.minimum(wpos, S - 1)
+        if quant:
+            # int8 KV: quantize each written position's per-head vector
+            # on scatter, dequantize the whole window on gather — same
+            # static shapes and masks as f32, so the quantized stream is
+            # the same program modulo the (deterministic) codec
+            from ..ops.fused_attention import kv_dequantize, kv_quantize
+            cks = self.variable("cache", "k_scale",
+                                lambda: jnp.zeros((b, S, H), jnp.float32))
+            cvs = self.variable("cache", "v_scale",
+                                lambda: jnp.zeros((b, S, H), jnp.float32))
+            kq, ks = kv_quantize(k)
+            vq, vs = kv_quantize(v)
+            kq_all = ck.value.at[rows, wclamp].set(kq)
+            vq_all = cv.value.at[rows, wclamp].set(vq)
+            ks_all = cks.value.at[rows, wclamp].set(ks)
+            vs_all = cvs.value.at[rows, wclamp].set(vs)
+            ck.value, cv.value, ci.value = kq_all, vq_all, i + t
+            cks.value, cvs.value = ks_all, vs_all
+            k_all = kv_dequantize(kq_all, ks_all, q.dtype)
+            v_all = kv_dequantize(vq_all, vs_all, q.dtype)
+        else:
+            k_all = ck.value.at[rows, wclamp].set(k)
+            v_all = cv.value.at[rows, wclamp].set(v)
+            ck.value, cv.value, ci.value = k_all, v_all, i + t
 
         # scores over the FULL cache (static shape S); mask out unwritten
         # slots and the causal future within this chunk, per row
@@ -291,10 +419,12 @@ class CausalSelfAttention(nn.Module):
             return z.reshape(b, t, H, hd)
 
         q, k, v = heads(q), heads(k), heads(v)
+        quant = cfg.kv_dtype == "int8"
+        kv_dt = jnp.int8 if quant else q.dtype
         ck = self.variable("cache", "k",
-                           lambda: jnp.zeros((P, page, H, hd), q.dtype))
+                           lambda: jnp.zeros((P, page, H, hd), kv_dt))
         cv = self.variable("cache", "v",
-                           lambda: jnp.zeros((P, page, H, hd), q.dtype))
+                           lambda: jnp.zeros((P, page, H, hd), kv_dt))
         i = cache_pos                                   # [b] per-row cursor
         wpos = i[:, None] + jnp.arange(t)[None, :]      # [b, t] write pos
         lblk = jnp.clip(wpos // page, 0, mb - 1)
@@ -303,17 +433,48 @@ class CausalSelfAttention(nn.Module):
         # cannot corrupt a live page; the positions are poisoned below
         phys = jnp.where(wpos < S, phys, 0)
         off = wpos % page
-        k_pool = ck.value.at[phys, off].set(k)
-        v_pool = cv.value.at[phys, off].set(v)
-        ck.value, cv.value = k_pool, v_pool
+        if quant:
+            # int8 page pool: quantize on scatter with one f32 scale per
+            # (page slot, head) — write-once per position, so shared
+            # prompt pages are bit-stable across readers, CoW copies the
+            # (int8, scale) pair verbatim, and spec-decode rollback stays
+            # a cursor rewind. The gather dequantizes into the SAME
+            # static [S] reduction window as f32, which keeps quantized
+            # paged streams bit-identical to the quantized unpaged
+            # engine/generate_fast.
+            from ..ops.fused_attention import kv_dequantize, kv_quantize
+            cks = self.variable("cache", "k_scale",
+                                lambda: jnp.zeros((P, page, H),
+                                                  jnp.float32))
+            cvs = self.variable("cache", "v_scale",
+                                lambda: jnp.zeros((P, page, H),
+                                                  jnp.float32))
+            kq, ks = kv_quantize(k)
+            vq, vs = kv_quantize(v)
+            k_pool = ck.value.at[phys, off].set(kq)
+            v_pool = cv.value.at[phys, off].set(vq)
+            ks_pool = cks.value.at[phys, off].set(ks)
+            vs_pool = cvs.value.at[phys, off].set(vs)
+            ck.value, cv.value = k_pool, v_pool
+            cks.value, cvs.value = ks_pool, vs_pool
+            k_all = kv_dequantize(k_pool[block_table].reshape(b, S, H, hd),
+                                  ks_pool[block_table].reshape(b, S, H),
+                                  q.dtype)
+            v_all = kv_dequantize(v_pool[block_table].reshape(b, S, H, hd),
+                                  vs_pool[block_table].reshape(b, S, H),
+                                  q.dtype)
+        else:
+            k_pool = ck.value.at[phys, off].set(k)
+            v_pool = cv.value.at[phys, off].set(v)
+            ck.value, cv.value = k_pool, v_pool
 
-        # gather each row's pages back into its logical [S] window and
-        # attend exactly like the unpaged path: the reductions run over
-        # the same static S axis with the same masks, which is what keeps
-        # paged token streams bit-identical to the unpaged engine and
-        # generate_fast
-        k_all = k_pool[block_table].reshape(b, S, H, hd)
-        v_all = v_pool[block_table].reshape(b, S, H, hd)
+            # gather each row's pages back into its logical [S] window
+            # and attend exactly like the unpaged path: the reductions
+            # run over the same static S axis with the same masks, which
+            # is what keeps paged token streams bit-identical to the
+            # unpaged engine and generate_fast
+            k_all = k_pool[block_table].reshape(b, S, H, hd)
+            v_all = v_pool[block_table].reshape(b, S, H, hd)
         att = jnp.einsum("bqhd,bkhd->bhqk", q, k_all) / math.sqrt(hd)
         col_pos = jnp.arange(S)                         # [S]
         mask = col_pos[None, None, :] <= wpos[:, :, None]   # [b, t, S]
@@ -337,12 +498,10 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool):
         cfg = self.config
-        x = nn.Dense(4 * cfg.n_embd, use_bias=cfg.bias,
-                     kernel_init=_init_normal(0.02), name="c_fc")(x)
+        x = _proj(cfg, 4 * cfg.n_embd, 0.02, "c_fc")(x)
         x = nn.gelu(x)
-        x = nn.Dense(cfg.n_embd, use_bias=cfg.bias,
-                     kernel_init=_init_normal(0.02 / math.sqrt(2 * cfg.n_layer)),
-                     name="c_proj")(x)
+        x = _proj(cfg, cfg.n_embd, 0.02 / math.sqrt(2 * cfg.n_layer),
+                  "c_proj")(x)
         return nn.Dropout(cfg.dropout, deterministic=not train)(x)
 
 
@@ -406,6 +565,23 @@ class GPT(nn.Module):
     def __call__(self, batch, train: bool = True, block_table=None,
                  cache_pos=None):
         cfg = self.config
+        if cfg.weights_dtype not in ("f32", "int8", "int4"):
+            raise ValueError(
+                f"weights_dtype must be 'f32', 'int8' or 'int4', got "
+                f"{cfg.weights_dtype!r}")
+        if cfg.kv_dtype not in ("f32", "int8"):
+            raise ValueError(
+                f"kv_dtype must be 'f32' or 'int8', got {cfg.kv_dtype!r}")
+        if cfg.weights_dtype != "f32":
+            if train:
+                raise ValueError(
+                    "quantized weights are inference-only — int8/int4 "
+                    "params carry no gradient; train with f32 and "
+                    "quantize at serving load (serve/load.py)")
+            if cfg.n_experts > 0:
+                raise ValueError(
+                    "quantized serving does not support MoE configs yet "
+                    "— serve MoE checkpoints with weights_dtype='f32'")
         if isinstance(batch, (tuple, list)):
             idx, targets = batch
         else:
@@ -449,8 +625,14 @@ class GPT(nn.Module):
             pos = pos_vec[None, :]
         else:
             pos = jnp.arange(t)[None, :]
-        wte = nn.Embed(cfg.vocab_size, cfg.n_embd,
-                       embedding_init=_init_normal(0.02), name="wte")
+        if cfg.weights_dtype != "f32" and cfg.quant_embed:
+            # the tied embedding/lm_head quantizes SEPARATELY from the
+            # block kernels (it dominates quality — default stays f32)
+            wte = QuantEmbed(cfg.vocab_size, cfg.n_embd,
+                             tile=cfg.quant_tile, name="wte")
+        else:
+            wte = nn.Embed(cfg.vocab_size, cfg.n_embd,
+                           embedding_init=_init_normal(0.02), name="wte")
         wpe = nn.Embed(cfg.block_size, cfg.n_embd,
                        embedding_init=_init_normal(0.02), name="wpe")
         x = wte(idx) + wpe(pos)
@@ -473,10 +655,14 @@ class GPT(nn.Module):
                 x = block_cls(cfg, name=f"h_{i}")(x, train, **kw)
         x = nn.LayerNorm(epsilon=1e-5, use_bias=cfg.bias, name="ln_f")(x)
         if targets is None:
-            # weight tying: lm_head = wteᵀ (reference :206-208)
+            # weight tying: lm_head = wteᵀ (reference :206-208); the
+            # quantized table's attend fuses its own dequant
+            if isinstance(wte, QuantEmbed):
+                return wte.attend(x)
             return wte.attend(x.astype(wte.embedding.dtype))
-        loss_sum, count = ce_sum_count(x, targets, wte.embedding,
-                                       cfg.loss_chunk)
+        emb = (wte.materialize() if isinstance(wte, QuantEmbed)
+               else wte.embedding)
+        loss_sum, count = ce_sum_count(x, targets, emb, cfg.loss_chunk)
         if cfg.seq_axis is not None:
             loss_sum = jax.lax.psum(loss_sum, cfg.seq_axis)
             count = jax.lax.psum(count, cfg.seq_axis)

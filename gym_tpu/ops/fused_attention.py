@@ -367,3 +367,43 @@ def _vjp_bwd_packed(n_head, scale, res, do):
 
 fused_causal_attention_packed.defvjp(_vjp_fwd_packed, _vjp_bwd_packed)
 
+
+# -- quantized KV (ISSUE 11: quantized serving) ---------------------------
+#
+# The decode KV caches (models/nanogpt.py:_decode_attend /
+# _decode_attend_paged) become int8-storable: the scatter quantizes each
+# written position's per-head K/V vector against its own max-abs scale
+# (one f32 scale per (page slot, head) — 4 bytes of sidecar per hd bytes
+# of int8 payload, i.e. 4/hd: 6.25% at head dim 64), and the gather
+# dequantizes back into the SAME static-shape reduction window the f32
+# path reduces over. Quantization is write-once and deterministic
+# (round-to-nearest — the QuantizeCodec idiom with stochastic=False and
+# the tile specialized to the head vector), so a shared prompt page is
+# bit-stable across readers and the paged stream equals the quantized
+# UNPAGED reference exactly: both paths quantize the identical K/V
+# vectors to identical (int8, scale) pairs and attend over identical
+# dequantized windows.
+
+KV_QMAX = 127  # int8 symmetric range, matching QuantizeCodec(bits=8)
+
+
+def kv_quantize(x: jax.Array):
+    """Per-(position, head) symmetric int8 quantization of a K/V chunk:
+    x [..., H, hd] f32 → (q int8 [..., H, hd], scale f32 [..., H]) with
+    ``scale = amax/127`` over each head vector (scale 1.0 for all-zero
+    vectors, so the roundtrip of zeros is exactly zero)."""
+    amax = jnp.max(jnp.abs(x), axis=-1)
+    scale = jnp.where(amax > 0, amax / KV_QMAX, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(x / scale[..., None]), -KV_QMAX, KV_QMAX)
+    return q.astype(jnp.int8), scale
+
+
+def kv_dequantize(q: jax.Array, scale: jax.Array,
+                  dtype=jnp.float32) -> jax.Array:
+    """Inverse of :func:`kv_quantize`: q [..., H, hd] int8 x scale
+    [..., H] → [..., H, hd] in ``dtype``. Inside the decode programs the
+    gather feeds this straight into the attention einsum — XLA fuses the
+    convert+multiply into the contraction operand, so the dequantized
+    window is a fusion temporary, never a stored f32 cache."""
+    return q.astype(dtype) * scale[..., None].astype(dtype)
+

@@ -207,3 +207,64 @@ def _outer_batch(args, dims):
 
 
 batching.primitive_batchers[grouped_outer_p] = _outer_batch
+
+
+# -- quantized matmul (ISSUE 11: quantized serving) -----------------------
+#
+# The serving engine stores weights as per-tile int8/int4 + f32 scales
+# (the strategy/compress.py QuantizeCodec tiling, applied at checkpoint
+# load — serve/load.py:quantize_params). These entry points CONSUME that
+# layout: the dequantize (convert + per-tile multiply) is expressed as an
+# elementwise producer of the contraction operand, which XLA fuses into
+# the dot's operand read — the weight tile is dequantized in-register
+# inside the contraction and no f32 weight buffer persists anywhere
+# (params stay int8 across dispatches; only the int8 values and the tiny
+# scale vector live in device memory).
+
+
+def quant_tile_for(shape, tile: int) -> int:
+    """Effective codec tile for a weight of ``shape``: the largest
+    divisor of the TRAILING axis that is <= ``tile``. Keeping every tile
+    inside one row of the (row-major) flattened weight means the scale
+    never straddles two output columns' rows — the alignment the fused
+    consumers below and the gather-dequant embedding path both rely on —
+    and, since the tile divides the element count exactly, the
+    QuantizeCodec pads nothing (q reshapes to the weight's own shape)."""
+    h = int(shape[-1])
+    t = max(1, min(int(tile), h))
+    while h % t:
+        t -= 1
+    return t
+
+
+def dequantize_tiles(q: jax.Array, scale: jax.Array,
+                     dtype=jnp.float32) -> jax.Array:
+    """Per-tile dequantize of a quantized array: ``q`` (int8, any shape
+    whose element count is ``len(scale) * tile``) x ``scale`` [T] → the
+    reconstructed array in ``q``'s shape. Inside a jit this is a pure
+    elementwise producer: when fed straight into a dot, XLA fuses it
+    into the contraction (no standalone f32 weight materializes as a
+    stored buffer)."""
+    t = scale.shape[0]
+    return (q.astype(dtype).reshape(t, -1)
+            * scale[:, None].astype(dtype)).reshape(q.shape)
+
+
+def quantized_dot(x: jax.Array, q: jax.Array,
+                  scale: jax.Array) -> jax.Array:
+    """``x @ dequant(q, scale)`` with the dequant fused into the
+    contraction: x [..., C] f32/bf16, q [C, H] int8 (int4 values are
+    stored in int8 — the 4-bit pack is a wire-format detail, see
+    QuantizeCodec), scale [C*H/tile] f32 per consecutive flat tile.
+    Returns [..., H] in ``x``'s dtype. This is the weight-consuming
+    entry point for the serving hot path (QuantDense in
+    models/nanogpt.py)."""
+    return x @ dequantize_tiles(q, scale, x.dtype)
+
+
+def quantized_attend(x: jax.Array, q: jax.Array,
+                     scale: jax.Array) -> jax.Array:
+    """``x @ dequant(q, scale).T`` — the tied-lm-head twin of
+    :func:`quantized_dot` (logits against a quantized [V, C] embedding),
+    same fusion contract."""
+    return x @ dequantize_tiles(q, scale, x.dtype).T

@@ -48,6 +48,22 @@ def _vec(n, dt):
 _KEY_T = jax.ShapeDtypeStruct((2,), np.uint32)
 
 
+def _qtag(cfg_tuple: tuple) -> str:
+    """Name suffix for quantized-serving configs (ISSUE 11): the f32
+    default keeps its historical names (grep-stable), while a quantized
+    program's NAME carries its dtypes — the auditor's recompile guard
+    treats same-name-different-key as a collision, so two dtype variants
+    of one program must not share a name."""
+    cfg = GPTConfig(*cfg_tuple)
+    parts = []
+    if cfg.weights_dtype != "f32":
+        parts.append(f"w={cfg.weights_dtype}"
+                     + ("+emb" if cfg.quant_embed else ""))
+    if cfg.kv_dtype != "f32":
+        parts.append(f"kv={cfg.kv_dtype}")
+    return ("," + ",".join(parts)) if parts else ""
+
+
 @functools.lru_cache(maxsize=64)
 def _templates(cfg_tuple: tuple, batch: int, paged: bool):
     """``(params_tpl, cache_tpl)`` aval pytrees for a ``batch``-row
@@ -382,7 +398,7 @@ def build_spec_decode(cfg_tuple: tuple, num_slots: int, chunk: int,
 def prefill_def(cfg_tuple: tuple, bucket: int) -> ProgramDef:
     params_tpl, _ = _templates(cfg_tuple, 1, False)
     return ProgramDef(
-        name=f"serve.prefill[bucket={bucket}]", family="serve.prefill",
+        name=f"serve.prefill[bucket={bucket}{_qtag(cfg_tuple)}]", family="serve.prefill",
         config={"config": cfg_tuple, "bucket": bucket},
         args=(params_tpl,
               jax.ShapeDtypeStruct((1, int(bucket)), np.int32),
@@ -396,7 +412,7 @@ def slot_admit_def(cfg_tuple: tuple, num_slots: int) -> ProgramDef:
     _, row_cache_tpl = _templates(cfg_tuple, 1, False)
     _, slot_cache_tpl = _templates(cfg_tuple, num_slots, False)
     return ProgramDef(
-        name=f"serve.admit[slots={num_slots}]", family="serve.admit",
+        name=f"serve.admit[slots={num_slots}{_qtag(cfg_tuple)}]", family="serve.admit",
         config={"config": cfg_tuple, "num_slots": num_slots},
         args=(slot_cache_tpl, row_cache_tpl, _scalar(np.int32),
               _scalar(np.int32)),
@@ -409,7 +425,7 @@ def slot_decode_def(cfg_tuple: tuple, num_slots: int,
     params_tpl, slot_cache_tpl = _templates(cfg_tuple, num_slots, False)
     s = num_slots
     return ProgramDef(
-        name=f"serve.decode[slots={s},chunk={chunk}]",
+        name=f"serve.decode[slots={s},chunk={chunk}{_qtag(cfg_tuple)}]",
         family="serve.decode",
         config={"config": cfg_tuple, "num_slots": s,
                 "decode_chunk": chunk},
@@ -438,7 +454,7 @@ def paged_prefill_def(cfg_tuple: tuple, bucket: int) -> ProgramDef:
     _cfg, mb, pcfg = _paged_cfg(cfg_tuple)
     params_tpl, pool_tpl = _templates(cfg_tuple, 1, True)
     return ProgramDef(
-        name=f"serve.paged_prefill[bucket={bucket}]",
+        name=f"serve.paged_prefill[bucket={bucket}{_qtag(cfg_tuple)}]",
         family="serve.paged_prefill",
         config={**pcfg, "bucket": bucket},
         args=(params_tpl, pool_tpl,
@@ -455,7 +471,7 @@ def cow_def(cfg_tuple: tuple) -> ProgramDef:
     cfg, _mb, pcfg = _paged_cfg(cfg_tuple)
     _, pool_tpl = _templates(cfg_tuple, 1, True)
     return ProgramDef(
-        name=f"serve.cow[page={cfg.page_size}]", family="serve.cow",
+        name=f"serve.cow[page={cfg.page_size}{_qtag(cfg_tuple)}]", family="serve.cow",
         config=pcfg,
         args=(pool_tpl, _scalar(np.int32), _scalar(np.int32)),
         donate_args=(0,),
@@ -468,7 +484,7 @@ def paged_decode_def(cfg_tuple: tuple, num_slots: int,
     params_tpl, pool_tpl = _templates(cfg_tuple, num_slots, True)
     s = num_slots
     return ProgramDef(
-        name=f"serve.paged_decode[slots={s},chunk={chunk}]",
+        name=f"serve.paged_decode[slots={s},chunk={chunk}{_qtag(cfg_tuple)}]",
         family="serve.paged_decode",
         config={**pcfg, "num_slots": s, "decode_chunk": chunk},
         args=(params_tpl, pool_tpl,
@@ -488,7 +504,7 @@ def spec_decode_def(cfg_tuple: tuple, num_slots: int, chunk: int,
     params_tpl, pool_tpl = _templates(cfg_tuple, num_slots, True)
     s = num_slots
     return ProgramDef(
-        name=f"serve.spec_decode[slots={s},chunk={chunk},gamma={gamma}]",
+        name=f"serve.spec_decode[slots={s},chunk={chunk},gamma={gamma}{_qtag(cfg_tuple)}]",
         family="serve.spec_decode",
         config={**pcfg, "num_slots": s, "decode_chunk": chunk,
                 "gamma": gamma},

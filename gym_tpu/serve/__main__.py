@@ -124,6 +124,21 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="speculative decoding draft length γ (0 = off; "
                         "paged only). Token streams stay exactly equal "
                         "to non-speculative decoding")
+    p.add_argument("--quant", choices=("int8", "int4"), default=None,
+                   help="quantize the restored params at load: per-tile "
+                        "int8/int4 + f32 scales (QuantizeCodec tiling), "
+                        "dequant fused into the consuming matmuls. "
+                        "Embedding/lm_head stay f32 unless "
+                        "--quant-embed. Default: f32 (no quantization)")
+    p.add_argument("--quant-embed", action="store_true",
+                   help="with --quant: also quantize the tied "
+                        "embedding/lm_head (they dominate quality — "
+                        "gated separately)")
+    p.add_argument("--kv-quant", choices=("int8",), default=None,
+                   help="store the decode KV cache/page pools int8 with "
+                        "per-(page-slot, head) f32 scales — the same "
+                        "kv_pages budget holds 4x the resident KV "
+                        "payload. Default: f32")
     p.add_argument("--max_queue", type=int, default=64,
                    help="FCFS queue bound (backpressure: submits beyond "
                         "it wait, then 429)")
@@ -379,6 +394,16 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
                 "page_size": int(getattr(eng0, "page_size", 0)),
                 "kv_pages": int(getattr(eng0, "kv_pages", 0)),
                 "spec_tokens": int(getattr(eng0, "spec_tokens", 0)),
+                # quantized serving (ISSUE 11): config echo + the
+                # f32-normalized pool capacity and actual byte
+                # footprints (honest accounting — scale sidecars
+                # reported, not hidden)
+                "weights_dtype": getattr(eng0, "weights_dtype", "f32"),
+                "kv_dtype": getattr(eng0, "kv_dtype", "f32"),
+                "kv_blocks_capacity_effective": sum(
+                    int(getattr(e, "kv_blocks_capacity_effective", 0))
+                    for e in engines),
+                "weights_bytes": int(getattr(eng0, "weights_bytes", 0)),
                 "kv_blocks_in_use": sum(s.kv_blocks_in_use
                                         for s in stats),
                 "kv_blocks_cached": sum(s.kv_blocks_cached
@@ -605,7 +630,12 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
 
 
 def main(argv=None) -> int:
-    args = _build_parser().parse_args(argv)
+    parser = _build_parser()
+    args = parser.parse_args(argv)
+    if getattr(args, "quant_embed") and not args.quant:
+        # refuse, don't silently no-op: quant_embed only has meaning on
+        # a quantized weight tree
+        parser.error("--quant-embed requires --quant {int8,int4}")
     if args.device == "cpu":
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         import jax
@@ -614,25 +644,37 @@ def main(argv=None) -> int:
     from ..utils.checkpoint import CheckpointNotFoundError
     from .load import CheckpointWatcher, load_for_serving
 
+    quant_kw = dict(weights_dtype=args.quant,
+                    kv_dtype=getattr(args, "kv_quant"),
+                    quant_embed=getattr(args, "quant_embed"))
     try:
         params, cfg, info = load_for_serving(
-            args.ckpt, step=args.step, config_path=args.config)
+            args.ckpt, step=args.step, config_path=args.config,
+            **quant_kw)
     except (CheckpointNotFoundError, FileNotFoundError, ValueError) as e:
         print(f"gym_tpu.serve: cannot load {args.ckpt}: {e}",
               file=sys.stderr)
         return 1
+    quant_note = ""
+    if args.quant or getattr(args, "kv_quant"):
+        quant_note = (f", quantized (weights {cfg.weights_dtype}"
+                      + (", embed" if cfg.quant_embed else "")
+                      + f", kv {cfg.kv_dtype})")
     print(f"gym_tpu.serve: restored step {info['step']} "
-          f"({info['num_nodes']}-node average) from {args.ckpt}",
-          flush=True)
+          f"({info['num_nodes']}-node average) from {args.ckpt}"
+          f"{quant_note}", flush=True)
 
     def reload_source(body):
         """POST /reload + the checkpoint watcher: re-read the run dir
         (newest valid step unless pinned) and hand back the node-
-        averaged params with a ``step-N`` weights tag. The architecture
-        must match — the fleet's compiled programs are config-keyed."""
+        averaged params with a ``step-N`` weights tag — quantized
+        through the same load-time step as startup, so a hot-swap never
+        silently changes serving dtype. The architecture must match —
+        the fleet's compiled programs are config-keyed."""
         ckpt = body.get("ckpt") or args.ckpt
         new_params, new_cfg, new_info = load_for_serving(
-            ckpt, step=body.get("step"), config_path=args.config)
+            ckpt, step=body.get("step"), config_path=args.config,
+            **quant_kw)
         if new_cfg != cfg:
             raise ValueError(
                 f"checkpoint {ckpt} carries a different model config — "
@@ -710,6 +752,8 @@ def main(argv=None) -> int:
     kv = (f"paged kv: page {eng.page_size} x {eng.kv_pages} pages"
           + (f", spec {eng.spec_tokens}" if eng.spec_tokens else "")
           if eng.paged else "unpaged kv")
+    if eng.weights_dtype != "f32" or eng.kv_dtype != "f32":
+        kv += f", quant w={eng.weights_dtype} kv={eng.kv_dtype}"
     print(f"gym_tpu.serve: listening on http://{args.host}:{handle.port} "
           f"({args.replicas} replica(s) x {args.num_slots} slots, "
           f"queue {args.max_queue}, {kv}, "

@@ -142,6 +142,11 @@ class EngineStats:
     # speculative-decoding counters (0 with speculation off)
     spec_drafted: int = 0
     spec_accepted: int = 0
+    # quantized-serving config echo (ISSUE 11): which dtypes this
+    # engine's params and KV pools are stored in — ride on stats so
+    # metrics/serve.csv/stats report them without reaching into config
+    weights_dtype: str = "f32"
+    kv_dtype: str = "f32"
 
     def spec_accept_rate(self) -> Optional[float]:
         """Accepted / drafted speculative tokens (None before the first
@@ -352,6 +357,16 @@ class InferenceEngine:
         self.paged = bool(paged)
         self.spec_tokens = int(spec_tokens)
         self.weights_tag = weights_tag
+        self.weights_dtype = str(getattr(config, "weights_dtype", "f32"))
+        self.kv_dtype = str(getattr(config, "kv_dtype", "f32"))
+        if self.weights_dtype not in ("f32", "int8", "int4"):
+            raise ValueError(
+                f"weights_dtype must be 'f32', 'int8' or 'int4', got "
+                f"{self.weights_dtype!r}")
+        if self.kv_dtype not in ("f32", "int8"):
+            raise ValueError(
+                f"kv_dtype must be 'f32' or 'int8', got "
+                f"{self.kv_dtype!r}")
         base_cfg = decode_config(config)
         self.block_size = int(config.block_size)
         self.num_slots = int(num_slots)
@@ -383,7 +398,16 @@ class InferenceEngine:
             self.kv_pages = 0
             self.config = base_cfg
             self._alloc = None
+        if self.weights_dtype != "f32":
+            # quantize-at-load: accept either an f32 checkpoint tree or
+            # a pre-quantized one (load_for_serving quantizes once; the
+            # fleet's factory rebuilds then detect and skip)
+            from .load import params_are_quantized, quantize_params
+            if not params_are_quantized(params):
+                params = quantize_params(params, self.config)
         self.params = jax.tree.map(jnp.asarray, params)
+        self.weights_bytes = int(sum(x.nbytes
+                                     for x in jax.tree.leaves(self.params)))
         self._cfg_tuple = dataclasses.astuple(self.config)
         # every program comes from the process-wide device-program
         # registry (gym_tpu.programs): engines over the same config —
@@ -429,8 +453,51 @@ class InferenceEngine:
         self._top_k = np.full(s, self.config.vocab_size, np.int32)
         self._top_p = np.ones(s, np.float32)
         self._base_keys = np.zeros((s, 2), np.uint32)
-        self.stats = EngineStats(num_slots=s)
+        self.stats = EngineStats(num_slots=s,
+                                 weights_dtype=self.weights_dtype,
+                                 kv_dtype=self.kv_dtype)
         self.last_logits: Optional[np.ndarray] = None  # [S, V] post-step
+
+    # -- quantized-serving observables ------------------------------------
+
+    @property
+    def kv_elem_bytes(self) -> int:
+        """Bytes per stored KV element (1 under int8, 4 under f32)."""
+        return 1 if self.kv_dtype == "int8" else 4
+
+    @property
+    def kv_blocks_capacity_effective(self) -> int:
+        """Usable block capacity normalized to the f32 payload budget:
+        an int8 pool stores 4 KV elements in every f32 element's bytes,
+        so the byte budget an f32 ``kv_pages`` pool's PAYLOAD occupies
+        holds ``4 x (kv_pages - 1)`` usable int8 blocks. The per-(page
+        slot, head) scale sidecar (4/hd of the int8 payload — 6.25% at
+        head dim 64) is NOT hidden inside this number: it is reported
+        separately by ``kv_pool_bytes``. Equals the plain usable-block
+        count on an f32 engine; 0 unpaged."""
+        if not self.paged:
+            return 0
+        return (self.kv_pages - 1) * (4 // self.kv_elem_bytes)
+
+    def kv_pool_bytes(self) -> Dict[str, int]:
+        """Actual device bytes of the KV cache, split into the K/V
+        payload and the quantization-scale sidecar (0 at f32) — the
+        honest-accounting observable behind the 4x capacity claim."""
+        payload = scales = 0
+
+        def walk(node):
+            nonlocal payload, scales
+            if hasattr(node, "items"):
+                for name, sub in node.items():
+                    if hasattr(sub, "items"):
+                        walk(sub)
+                    elif name in ("k", "v"):
+                        payload += int(sub.nbytes)
+                    elif name.endswith("_scale"):
+                        scales += int(sub.nbytes)
+
+        walk(self._cache)
+        return {"payload": payload, "scales": scales}
 
     # -- device programs (registry-backed) --------------------------------
 

@@ -39,6 +39,80 @@ from ..utils.checkpoint import CheckpointNotFoundError, restore_params
 PyTree = Any
 
 
+# -- quantize-at-load (ISSUE 11: quantized serving) -----------------------
+
+
+def params_are_quantized(params: PyTree) -> bool:
+    """True when the tree already carries quantized leaves (``qkernel``/
+    ``qembedding``) — lets every construction path (engine, fleet
+    factory, hot-swap reload) accept either an f32 checkpoint tree or a
+    pre-quantized one without re-quantizing."""
+    found = False
+
+    def walk(node):
+        nonlocal found
+        if hasattr(node, "items"):
+            for name, sub in node.items():
+                if name in ("qkernel", "qembedding"):
+                    found = True
+                walk(sub)
+
+    walk(params)
+    return found
+
+
+def quantize_params(params: PyTree, config) -> PyTree:
+    """Quantize an f32 GPT param tree for serving under ``config``
+    (``weights_dtype`` 'int8'/'int4', optional ``quant_embed``): every
+    2-D block ``kernel`` — and the ``wte`` embedding when
+    ``quant_embed`` — becomes ``(qkernel|qembedding, qscale)`` via the
+    SAME per-tile max-abs codec the compressed collectives use
+    (``strategy/compress.py:QuantizeCodec``, ``stochastic=False`` —
+    weights are quantized once, deterministically, not per-step
+    gradients). The tile is clamped per-leaf to divide the trailing
+    axis (``ops/grouped_matmul.py:quant_tile_for``) so the codec pads
+    nothing and scales never straddle rows; biases, LayerNorms and
+    ``wpe`` stay f32. The resulting tree is exactly what a
+    ``weights_dtype``-configured ``GPT`` consumes (QuantDense /
+    QuantEmbed param names) — a no-op at ``weights_dtype='f32'``."""
+    wd = getattr(config, "weights_dtype", "f32")
+    if wd == "f32":
+        return params
+    if wd not in ("int8", "int4"):
+        raise ValueError(
+            f"weights_dtype must be 'f32', 'int8' or 'int4', got {wd!r}")
+    from ..ops.grouped_matmul import quant_tile_for
+    from ..strategy.compress import QuantizeCodec
+    bits = {"int8": 8, "int4": 4}[wd]
+    tile = int(getattr(config, "quant_tile", 256))
+
+    def q_leaf(w):
+        t = quant_tile_for(w.shape, tile)
+        codec = QuantizeCodec(bits=bits, tile=t, stochastic=False)
+        q, scale = codec.compress(
+            jnp.asarray(w, jnp.float32).reshape(-1), None)
+        return q.reshape(w.shape), scale.reshape(-1)
+
+    def walk(node, name=None):
+        if not hasattr(node, "items"):
+            return node
+        d = dict(node)
+        kern = d.get("kernel")
+        if kern is not None and getattr(kern, "ndim", 0) == 2:
+            q, scale = q_leaf(kern)
+            out = {"qkernel": q, "qscale": scale}
+            if "bias" in d:
+                out["bias"] = jnp.asarray(d["bias"], jnp.float32)
+            return out
+        if (name == "wte" and getattr(config, "quant_embed", False)
+                and "embedding" in d):
+            q, scale = q_leaf(d["embedding"])
+            return {"qembedding": q, "qscale": scale}
+        return {k: walk(v, k) for k, v in d.items()}
+
+    return walk(params)
+
+
 def read_run_config(run_dir: str,
                     config_path: Optional[str] = None) -> Dict[str, Any]:
     """Load the run's captured ``config.json``. Looked up in the run dir
@@ -70,7 +144,10 @@ def gpt_config_from_run(config: Dict[str, Any]) -> GPTConfig:
 
 def load_for_serving(run_dir: str, step: Optional[int] = None,
                      config: Optional[GPTConfig] = None,
-                     config_path: Optional[str] = None
+                     config_path: Optional[str] = None,
+                     weights_dtype: Optional[str] = None,
+                     kv_dtype: Optional[str] = None,
+                     quant_embed: bool = False
                      ) -> Tuple[PyTree, GPTConfig, Dict[str, Any]]:
     """Restore a ``fit()`` run dir for inference.
 
@@ -79,6 +156,12 @@ def load_for_serving(run_dir: str, step: Optional[int] = None,
     the engine sanitizes via ``decode_config``), and an info dict
     (``step``, ``num_nodes``, the raw run config). ``config=`` skips the
     ``config.json`` lookup entirely (e.g. serving hand-built params).
+
+    ``weights_dtype`` ('int8'/'int4') runs the quantize-at-load step —
+    the returned params are the per-tile-quantized tree and the returned
+    config carries the dtype (with ``quant_embed`` optionally extending
+    quantization to the tied embedding/lm_head); ``kv_dtype`` ('int8')
+    just stamps the config — the KV pools quantize online at decode.
     """
     if not os.path.isdir(run_dir):
         raise CheckpointNotFoundError(
@@ -104,6 +187,13 @@ def load_for_serving(run_dir: str, step: Optional[int] = None,
     avg = jax.jit(
         lambda t: jax.tree.map(lambda x: jnp.mean(x, axis=0), t)
     )(node_params)
+    if weights_dtype or kv_dtype or quant_embed:
+        config = dataclasses.replace(
+            config,
+            weights_dtype=weights_dtype or config.weights_dtype,
+            kv_dtype=kv_dtype or config.kv_dtype,
+            quant_embed=bool(quant_embed) or config.quant_embed)
+        avg = quantize_params(avg, config)
     info = {"step": at_step, "num_nodes": k, "run_config": raw}
     return avg, config, info
 

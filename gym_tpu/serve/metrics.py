@@ -77,6 +77,12 @@ HEADER = [
     # programs_compiled matches the previous engine row is the
     # zero-recompile seam, on disk.
     "programs_built", "programs_compiled", "program_compile_s",
+    # quantized serving (ISSUE 11; engine rows): the dtype the params
+    # and KV pools are stored in — the config echo that makes a
+    # serve.csv self-describing about WHAT was serving when its rates
+    # were sampled. Absent in pre-quantization CSVs; read_headline
+    # tolerates both (like the paging and fleet schema bumps).
+    "weights_dtype", "kv_dtype",
 ]
 
 #: EWMA smoothing for the live tokens/s estimate (per driver tick with
@@ -286,6 +292,11 @@ class ServeMetrics:
         self._kv_blocks_in_use = 0
         self._prefix_hit_blocks = 0
         self._spec_accept_rate: Optional[float] = None
+        # last engine sample of the quantized-serving config echo (None
+        # until the first tick; fleet replicas share one config, so a
+        # collector-level last-wins sample is exact)
+        self._weights_dtype: Optional[str] = None
+        self._kv_dtype: Optional[str] = None
 
     def _now(self) -> float:
         return time.perf_counter() - self._t0
@@ -357,6 +368,7 @@ class ServeMetrics:
                 "" if lat is None else f"{lat:.5f}",
                 self.tokens_out, f"{self.tokens_per_s():.2f}",
                 "", "", "", self._rid_cell(replica_id), "", "", "",
+                "", "",
             ])
             self._f.flush()
 
@@ -376,6 +388,7 @@ class ServeMetrics:
                 queue_depth, active_slots, "", "", "", "",
                 self.tokens_out, f"{self.tokens_per_s():.2f}",
                 "", "", "", self._rid_cell(replica_id), "", "", "",
+                "", "",
             ])
             self._f.flush()
 
@@ -393,6 +406,7 @@ class ServeMetrics:
                 "", "", "", "", self.tokens_out,
                 f"{self.tokens_per_s():.2f}", "", "", "",
                 self._rid_cell(replica_id), *self._program_cells(),
+                self._weights_dtype or "", self._kv_dtype or "",
             ])
             self._f.flush()
 
@@ -411,6 +425,7 @@ class ServeMetrics:
                 "", "", "", "", self.tokens_out,
                 f"{self.tokens_per_s():.2f}", "", "", "",
                 self._rid_cell(replica_id), *self._program_cells(),
+                self._weights_dtype or "", self._kv_dtype or "",
             ])
             self._f.flush()
 
@@ -435,6 +450,12 @@ class ServeMetrics:
             ph = int(getattr(stats, "prefix_hit_blocks", 0))
             rate_fn = getattr(stats, "spec_accept_rate", None)
             sr = rate_fn() if callable(rate_fn) else None
+            wd = getattr(stats, "weights_dtype", None)
+            kd = getattr(stats, "kv_dtype", None)
+            if wd:
+                self._weights_dtype = str(wd)
+            if kd:
+                self._kv_dtype = str(kd)
             if rep is None:
                 self._kv_blocks_in_use = kv
                 self._prefix_hit_blocks = ph
@@ -452,6 +473,7 @@ class ServeMetrics:
                 stats.tokens_generated, f"{self.tokens_per_s():.2f}",
                 kv, ph, ("" if sr is None else f"{sr:.4f}"),
                 self._rid_cell(replica_id), *self._program_cells(),
+                self._weights_dtype or "", self._kv_dtype or "",
             ])
 
     def tokens_per_s(self) -> float:
@@ -519,6 +541,8 @@ class ServeMetrics:
                 "prefix_hit_blocks": ph,
                 "spec_accept_rate": (
                     round(sr, 4) if sr is not None else None),
+                "weights_dtype": self._weights_dtype,
+                "kv_dtype": self._kv_dtype,
             }
             progs = _program_counters()
             if progs is not None:
@@ -582,6 +606,8 @@ def read_headline(path: str) -> Dict[str, Any]:
     ttfts: List[float] = []
     lats: List[float] = []
     kv_blocks, prefix_hits, spec_rate = 0, 0, None
+    weights_dtype: Optional[str] = None
+    kv_dtype: Optional[str] = None
     programs: Optional[Dict[str, Any]] = None
     per_rep: Dict[str, Dict[str, int]] = {}
 
@@ -613,6 +639,12 @@ def read_headline(path: str) -> Dict[str, Any]:
                     prefix_hits = int(row["prefix_hit_blocks"])
                 if row.get("spec_accept_rate"):
                     spec_rate = float(row["spec_accept_rate"])
+                # quantized-serving config echo: last engine sample wins
+                # (columns absent in pre-quantization CSVs)
+                if row.get("weights_dtype"):
+                    weights_dtype = row["weights_dtype"]
+                if row.get("kv_dtype"):
+                    kv_dtype = row["kv_dtype"]
                 # registry counters: last engine sample wins (columns
                 # absent in pre-registry CSVs)
                 if row.get("programs_built"):
@@ -658,6 +690,8 @@ def read_headline(path: str) -> Dict[str, Any]:
         "kv_blocks_in_use": kv_blocks,
         "prefix_hit_blocks": prefix_hits,
         "spec_accept_rate": spec_rate,
+        "weights_dtype": weights_dtype,
+        "kv_dtype": kv_dtype,
     }
     if programs is not None:
         head["programs"] = programs

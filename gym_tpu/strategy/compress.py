@@ -145,6 +145,15 @@ class TopKCodec(Codec):
     (Stich et al., arXiv:1809.07599 — the standard EF-SGD recipe).
     ``frac >= 1`` keeps everything — a lossless configuration whose
     decompress is bit-exact (pinned in tests).
+
+    Selection delegates to ``ops/topk_compress.py:topk_compress`` — the
+    repo's ONE top-k kernel (the DeMo chunk compressor): on TPU it packs
+    the chunk index into |value|'s low mantissa bits and selects via a
+    single-array ``approx_max_k`` (recall 1.0) instead of a paired sort.
+    The returned VALUES are exact (gathered from x itself, pinned by the
+    parity test in tests/test_compress.py); only near-equal-|magnitude|
+    tie order may differ from a paired sort, which a lossy compressor
+    does not define anyway.
     """
 
     frac: float = 0.01
@@ -159,9 +168,10 @@ class TopKCodec(Codec):
 
     def compress(self, x: jnp.ndarray, key) -> Payload:
         del key  # deterministic selection
+        from ..ops.topk_compress import topk_compress
         k = self.k_of(x.size)
-        _, idx = jax.lax.top_k(jnp.abs(x.astype(jnp.float32)), k)
-        return idx.astype(jnp.int32), x.astype(jnp.float32)[idx]
+        idx, val = topk_compress(x.astype(jnp.float32)[None], k)
+        return idx[0], val[0]
 
     def decompress(self, payload: Payload, n: int) -> jnp.ndarray:
         idx, val = payload

@@ -41,13 +41,20 @@ assert set(sections) == {"lint", "trace", "audit"}
 for name, summ in sections["trace"]["strategies"].items():
     assert summ["ok"], (name, summ)
 assert len(sections["trace"]["strategies"]) >= 12
-assert len(sections["audit"]["programs"]) >= 21
+# ISSUE 11 bump: + the quantized serving family (int8 weights + int8
+# paged KV — paged prefill x2, CoW, paged decode, spec decode)
+assert len(sections["audit"]["programs"]) >= 26
 # ISSUE 9 gate: the auditor's serve key set and the device-program
 # registry's key set are THE SAME set — enumeration and acquisition
 # cannot drift apart
 recon = sections["audit"]["registry"]
 assert recon["key_set_match"], recon
-assert recon["n_registry_keys"] == recon["n_audit_serve_keys"] >= 9, recon
+assert recon["n_registry_keys"] == recon["n_audit_serve_keys"] >= 14, recon
+# ISSUE 11 gate: quantized programs are registered + audited with
+# dtype-tagged names, donation-clean (violations==0 above covers them)
+qnames = [p["name"] for p in sections["audit"]["programs"]
+          if "w=int8" in p["name"]]
+assert len(qnames) >= 4, qnames
 print("ci_analyze: violations=0 across",
       len(sections["trace"]["strategies"]), "strategy configs and",
       len(sections["audit"]["programs"]), "programs;",

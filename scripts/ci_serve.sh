@@ -10,7 +10,10 @@
 # SHARING live (two requests sharing a prompt prefix ->
 # prefix_hit_blocks > 0 in /stats), then the SIGTERM drill — the server
 # must exit rc=0 with a clean-shutdown line and a tokens_per_s
-# headline. CPU-only; sized for the 2-core container.
+# headline. A second pass re-serves QUANTIZED (ISSUE 11: --quant int8
+# --kv-quant int8 → 200s, /stats echoes the dtypes, and a warmed
+# restart serves with programs_compiled=0). CPU-only; sized for the
+# 2-core container.
 #
 # Usage: scripts/ci_serve.sh   (from the repo root or anywhere)
 set -o pipefail
@@ -202,6 +205,127 @@ kill -TERM "$SRV"
 wait "$SRV"; rc=$?
 [ "$rc" -ne 0 ] && { echo "ci_serve: restarted server exit rc=$rc";
     cat "$OUT/server2.log"; exit 1; }
+
+# Quantized live smoke (ISSUE 11): serve the SAME checkpoint with
+# --quant int8 --kv-quant int8 — requests answer 200, /stats echoes the
+# dtypes and the f32-normalized pool capacity, and after the background
+# warmup a process RESTART against the quantized program cache serves
+# with programs_compiled=0 (the warmup family covers the quantized
+# programs too).
+env JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python -m gym_tpu.serve \
+    --ckpt "$OUT/ckpts/ci" --port "$PORT" --num_slots 2 --device cpu \
+    --quant int8 --kv-quant int8 \
+    --program-cache-dir "$OUT/progcache_q" \
+    > "$OUT/server3.log" 2>&1 &
+SRV=$!
+for _ in $(seq 1 90); do
+    grep -q "listening" "$OUT/server3.log" && break
+    kill -0 "$SRV" 2>/dev/null || { echo "ci_serve: quantized server died";
+        cat "$OUT/server3.log"; exit 1; }
+    sleep 1
+done
+grep -q "listening" "$OUT/server3.log" || {
+    echo "ci_serve: quantized server never started"; kill -9 "$SRV"; exit 1; }
+
+timeout -k 10 180 env GYM_TPU_CI_SERVE_PORT="$PORT" python - <<'EOF'
+import json, os, urllib.request
+
+port = os.environ["GYM_TPU_CI_SERVE_PORT"]
+for seed in range(2):
+    body = json.dumps({"prompt": [1, 2, 3], "max_new_tokens": 6,
+                       "top_k": 4, "seed": seed}).encode()
+    r = urllib.request.urlopen(urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate", body,
+        {"Content-Type": "application/json"}), timeout=120)
+    assert r.status == 200, r.status
+    assert len(json.loads(r.read())["tokens"]) == 6
+stats = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/stats", timeout=10).read())
+assert stats["weights_dtype"] == "int8", stats.get("weights_dtype")
+assert stats["kv_dtype"] == "int8", stats.get("kv_dtype")
+assert stats["kv_blocks_capacity_effective"] == 4 * (stats["kv_pages"] - 1), \
+    (stats["kv_blocks_capacity_effective"], stats["kv_pages"])
+assert stats["requests_done"] == 2, stats["requests_done"]
+print("ci_serve: quantized smoke — weights", stats["weights_dtype"],
+      "kv", stats["kv_dtype"],
+      "capacity_eff", stats["kv_blocks_capacity_effective"],
+      "weights_bytes", stats["weights_bytes"])
+EOF
+rc=$?
+[ "$rc" -ne 0 ] && { echo "ci_serve: quantized smoke failed";
+    cat "$OUT/server3.log"; kill -9 "$SRV"; exit "$rc"; }
+
+# wait for the quantized warmup so every quantized program persists
+timeout -k 10 120 env GYM_TPU_CI_SERVE_PORT="$PORT" python - <<'EOF'
+import json, os, time, urllib.request
+
+port = os.environ["GYM_TPU_CI_SERVE_PORT"]
+deadline = time.monotonic() + 110
+while time.monotonic() < deadline:
+    stats = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/stats", timeout=10).read())
+    w = stats.get("warmup")
+    if w is None or w.get("done"):
+        print("ci_serve: quantized warmup done:", w)
+        break
+    time.sleep(1)
+else:
+    raise SystemExit(f"quantized warmup never finished: {stats.get('warmup')}")
+EOF
+rc=$?
+[ "$rc" -ne 0 ] && { echo "ci_serve: quantized warmup wait failed";
+    cat "$OUT/server3.log"; kill -9 "$SRV"; exit "$rc"; }
+
+kill -TERM "$SRV"
+wait "$SRV"; rc=$?
+[ "$rc" -ne 0 ] && { echo "ci_serve: quantized server exit rc=$rc";
+    cat "$OUT/server3.log"; exit 1; }
+
+# quantized restart drill: a warmed restart must serve quantized with
+# ZERO XLA compiles (the ISSUE 11 acceptance bar)
+env JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python -m gym_tpu.serve \
+    --ckpt "$OUT/ckpts/ci" --port "$PORT" --num_slots 2 --device cpu \
+    --quant int8 --kv-quant int8 \
+    --program-cache-dir "$OUT/progcache_q" \
+    > "$OUT/server4.log" 2>&1 &
+SRV=$!
+for _ in $(seq 1 90); do
+    grep -q "listening" "$OUT/server4.log" && break
+    kill -0 "$SRV" 2>/dev/null || {
+        echo "ci_serve: quantized restart died";
+        cat "$OUT/server4.log"; exit 1; }
+    sleep 1
+done
+grep -q "listening" "$OUT/server4.log" || {
+    echo "ci_serve: quantized restart never started"; kill -9 "$SRV"; exit 1; }
+
+timeout -k 10 180 env GYM_TPU_CI_SERVE_PORT="$PORT" python - <<'EOF'
+import json, os, urllib.request
+
+port = os.environ["GYM_TPU_CI_SERVE_PORT"]
+body = json.dumps({"prompt": [1, 2, 3], "max_new_tokens": 6,
+                   "top_k": 4, "seed": 0}).encode()
+r = urllib.request.urlopen(urllib.request.Request(
+    f"http://127.0.0.1:{port}/generate", body,
+    {"Content-Type": "application/json"}), timeout=120)
+assert r.status == 200, r.status
+assert len(json.loads(r.read())["tokens"]) == 6
+stats = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/stats", timeout=10).read())
+assert stats["weights_dtype"] == "int8" and stats["kv_dtype"] == "int8"
+assert stats["programs_compiled"] == 0, (
+    f"quantized restart recompiled {stats['programs_compiled']} programs "
+    f"(registry: {stats.get('programs')})")
+print("ci_serve: quantized restart drill — first request 200,",
+      "programs_compiled =", stats["programs_compiled"])
+EOF
+rc=$?
+[ "$rc" -ne 0 ] && { echo "ci_serve: quantized restart drill failed";
+    cat "$OUT/server4.log"; kill -9 "$SRV"; exit "$rc"; }
+kill -TERM "$SRV"
+wait "$SRV"; rc=$?
+[ "$rc" -ne 0 ] && { echo "ci_serve: quantized restart exit rc=$rc";
+    cat "$OUT/server4.log"; exit 1; }
 
 echo "ci_serve: OK (log at $OUT/server.log)"
 exit 0

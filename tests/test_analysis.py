@@ -402,7 +402,7 @@ def test_shipped_programs_audit_clean():
     rep = audit_shipped_programs()
     assert rep["violations"] == 0, rep
     names = {p["name"] for p in rep["programs"]}
-    assert len(names) == len(rep["programs"]) >= 17
+    assert len(names) == len(rep["programs"]) >= 26
     assert any(n.startswith("serve.decode") for n in names)
     assert any(n.startswith("serve.prefill") for n in names)
     # ISSUE 7: the paged/speculative serving programs are audited too
@@ -410,6 +410,11 @@ def test_shipped_programs_audit_clean():
     assert any(n.startswith("serve.paged_decode") for n in names)
     assert any(n.startswith("serve.spec_decode") for n in names)
     assert any(n.startswith("serve.cow") for n in names)
+    # ISSUE 11: the quantized family is audited too — donation-clean
+    # int8 pools/scales, distinct names (dtype tag) and distinct keys
+    assert any("w=int8" in n and "kv=int8" in n for n in names)
+    assert any(n.startswith("serve.paged_decode[") and "w=int8" in n
+               for n in names)
     assert rep["recompile_guard"]["n_keys"] == len(rep["programs"])
 
 

@@ -128,6 +128,33 @@ def test_topk_wire_bytes_accounting():
     assert codec.wire_bytes(10) == 1 * 8.0      # k >= 1 floor
 
 
+def test_topk_selection_parity_with_paired_sort():
+    """ONE top-k implementation in the tree (ISSUE 11 satellite): the
+    codec now selects through ``ops/topk_compress.py``'s packed
+    ``approx_max_k`` path. Value-exactness parity against the retired
+    paired-sort selection: for a vector with distinct |magnitudes| the
+    selected SET is identical and every transmitted value is the exact
+    f32 from x (never a reconstruction), so the decompressed vectors
+    match bit-for-bit."""
+    from jax import lax
+
+    codec = TopKCodec(frac=0.05)
+    x = _vec(4096, seed=11)                     # continuous → distinct |x|
+    k = codec.k_of(x.size)
+    idx, val = codec.compress(x, None)
+    # transmitted values are exact gathers from x
+    np.testing.assert_array_equal(np.asarray(val),
+                                  np.asarray(x)[np.asarray(idx)])
+    # the retired implementation: paired |x| top-k
+    _, ref_idx = lax.top_k(jnp.abs(x), k)
+    assert set(np.asarray(idx).tolist()) == set(
+        np.asarray(ref_idx).tolist())
+    ref_dec = np.zeros(x.size, np.float32)
+    ref_dec[np.asarray(ref_idx)] = np.asarray(x)[np.asarray(ref_idx)]
+    np.testing.assert_array_equal(
+        np.asarray(codec.decompress((idx, val), x.size)), ref_dec)
+
+
 # -- factory / keys --------------------------------------------------------
 
 

@@ -613,3 +613,246 @@ def test_unpaged_engine_reports_zero_paged_stats(setup):
     assert eng.stats.prefix_hit_blocks == 0
     assert eng.stats.spec_accept_rate() is None
     assert eng.stats.prefill_tokens == 8       # bucket(6) — comparable
+
+
+# -- quantized serving (ISSUE 11) ------------------------------------------
+#
+# The oracle shape is unchanged: quantized streams are compared against
+# the QUANTIZED unpaged reference (generate_fast under the same
+# weights_dtype/kv_dtype config — both paths quantize identical K/V
+# vectors to identical (int8, scale) pairs and attend over identical
+# dequantized windows, so the streams match bitwise). f32-vs-int8
+# divergence is a QUALITY observable, measured separately — never an
+# exactness assert.
+
+import dataclasses
+
+
+def _quant(setup, weights_dtype="f32", kv_dtype="int8"):
+    cfg, model, params = setup
+    qcfg = dataclasses.replace(cfg, weights_dtype=weights_dtype,
+                               kv_dtype=kv_dtype)
+    from gym_tpu.serve.load import quantize_params
+    return qcfg, quantize_params(params, qcfg)
+
+
+@pytest.mark.parametrize("kv_dtype", ["f32", "int8"])
+def test_prefix_sharing_exact_under_kv_dtype(setup, kv_dtype):
+    """The kv_dtype param axis on the ISSUE 7 prefix-share oracle: the
+    second admit reuses the resident (quantized) blocks — prefill
+    shrinks to the suffix bucket — and both streams equal their solo
+    quantized-unpaged generate_fast runs. Shared quantized pages are
+    write-once (int8, scale) pairs, so sharing stays bit-stable."""
+    qcfg, qparams = _quant(setup, kv_dtype=kv_dtype)
+    shared = _prompt(24, 170)
+    pa = np.concatenate([shared, _prompt(4, 171)])
+    pb = np.concatenate([shared, _prompt(4, 172)])
+    eng = InferenceEngine(qparams, qcfg, num_slots=2, paged=True,
+                          page_size=8)
+    ra = _run_one(eng, pa, SamplingParams(max_new_tokens=6,
+                                          temperature=0.8, top_k=5,
+                                          seed=1))
+    tokens_first = eng.stats.prefill_tokens
+    rb = _run_one(eng, pb, SamplingParams(max_new_tokens=6,
+                                          temperature=0.8, top_k=5,
+                                          seed=2))
+    assert eng.stats.prefix_hit_blocks == 3
+    assert eng.stats.prefill_tokens - tokens_first == 4
+    assert ra == generate_fast(qparams, qcfg, pa[None], 6,
+                               temperature=0.8, top_k=5,
+                               seed=1)[0, 28:].tolist()
+    assert rb == generate_fast(qparams, qcfg, pb[None], 6,
+                               temperature=0.8, top_k=5,
+                               seed=2)[0, 28:].tolist()
+
+
+@pytest.mark.parametrize("kv_dtype", ["f32", "int8"])
+def test_cow_triple_exact_under_kv_dtype(setup, kv_dtype):
+    """CoW triple-exactness on the kv_dtype axis: a fully block-aligned
+    re-admit copies the (int8, scale) page verbatim — the shared source
+    page is not perturbed, so the third request is exact too."""
+    qcfg, qparams = _quant(setup, kv_dtype=kv_dtype)
+    p16 = _prompt(16, 180)
+    eng = InferenceEngine(qparams, qcfg, num_slots=2, paged=True,
+                          page_size=8)
+    r1 = _run_one(eng, p16, SamplingParams(max_new_tokens=5, top_k=4,
+                                           seed=3))
+    before = eng.stats.prefill_tokens
+    r2 = _run_one(eng, p16, SamplingParams(max_new_tokens=5, top_k=4,
+                                           seed=4))
+    assert eng.stats.prefill_tokens - before == 1
+    r3 = _run_one(eng, p16, SamplingParams(max_new_tokens=5, top_k=4,
+                                           seed=3))
+    for r, seed in ((r1, 3), (r2, 4), (r3, 3)):
+        assert r == generate_fast(qparams, qcfg, p16[None], 5, top_k=4,
+                                  seed=seed)[0, 16:].tolist()
+
+
+@pytest.mark.parametrize("kv_dtype", ["int8"])
+def test_churn_isolated_under_int8_kv(setup, kv_dtype):
+    """Churn isolation under int8 KV (weights int8 too — the full
+    quantized hot path): mixed concurrent requests through one shared
+    quantized pool all equal their solo quantized references, and the
+    pool drains to zero."""
+    qcfg, qparams = _quant(setup, weights_dtype="int8",
+                           kv_dtype=kv_dtype)
+    eng = InferenceEngine(qparams, qcfg, num_slots=2, decode_chunk=4,
+                          paged=True, page_size=8)
+    sched = Scheduler(eng, max_queue=8)
+    handles, wants = [], []
+    for i, (plen, mnew) in enumerate([(5, 7), (9, 12), (17, 9),
+                                      (8, 15)]):
+        prompt = _prompt(plen, 190 + i)
+        ref = generate_fast(qparams, qcfg, prompt[None], mnew,
+                            temperature=0.9, top_k=7, top_p=0.95, seed=i)
+        wants.append(ref[0, plen:].tolist())
+        handles.append(sched.submit(prompt, SamplingParams(
+            max_new_tokens=mnew, temperature=0.9, top_k=7, top_p=0.95,
+            seed=i)))
+    _drain(sched, handles)
+    for h, want in zip(handles, wants):
+        assert h.result(timeout=1) == want
+    assert eng.stats.kv_blocks_in_use == 0
+
+
+def test_quantized_spec_decode_exact(setup):
+    """Speculative decoding on the fully quantized path: draft/verify
+    over int8 weights + int8 KV still emits the exact non-speculative
+    quantized stream (rollback is a cursor rewind — quantized drafts sit
+    past the cursor like f32 ones)."""
+    qcfg, qparams = _quant(setup, weights_dtype="int8", kv_dtype="int8")
+    prompt = _prompt(10, 121)
+    ref = generate_fast(qparams, qcfg, prompt[None], 12, temperature=0.9,
+                        top_k=7, seed=5)[0, 10:].tolist()
+    spec = InferenceEngine(qparams, qcfg, num_slots=2, paged=True,
+                           page_size=8, decode_chunk=3, spec_tokens=3)
+    got = _run_one(spec, prompt, SamplingParams(max_new_tokens=12,
+                                                temperature=0.9, top_k=7,
+                                                seed=5))
+    assert got == ref
+    assert spec.stats.spec_drafted > 0
+
+
+def test_quarantine_under_int8_kv(setup):
+    """NaN quarantine still fails ONLY the poisoned slot under int8 KV:
+    the f32 SCALE pool carries the poison (int8 payload cannot hold a
+    NaN), dequant propagates it to that slot's logits, the latch
+    catches it, and the neighbor stays clean."""
+    import jax.numpy as jnp
+
+    qcfg, qparams = _quant(setup, kv_dtype="int8")
+    eng = InferenceEngine(qparams, qcfg, num_slots=2, paged=True,
+                          page_size=8, decode_chunk=4)
+    slot, _ = eng.admit(_prompt(8, 1), SamplingParams(max_new_tokens=3))
+    other, _ = eng.admit(_prompt(6, 2), SamplingParams(max_new_tokens=8))
+    pg = int(eng._bt[slot, 0])
+    eng._cache = jax.tree.map(
+        lambda x: x.at[pg].set(jnp.nan) if x.dtype == jnp.float32 else x,
+        eng._cache)
+    evs = eng.step()
+    mine = [e for e in evs if e.slot == slot]
+    assert mine and all(e.poisoned for e in mine)
+    assert eng.stats.quarantined == 1
+    assert all(not e.poisoned for e in evs if e.slot == other)
+
+
+def test_int8_kv_capacity_4x_structural(setup):
+    """The ISSUE 11 acceptance assert, structurally: at the SAME KV
+    payload byte budget (4 int8 pages per f32 page) the int8 pool holds
+    >= 4x the resident prefix blocks. Deterministic — sequential
+    distinct one-block prompts, no timing anywhere."""
+    cfg, model, params = setup
+    qcfg, qparams = _quant(setup, kv_dtype="int8")
+
+    def arm(c, p, kv_pages):
+        eng = InferenceEngine(p, c, num_slots=2, paged=True, page_size=8,
+                              kv_pages=kv_pages)
+        for i in range(48):
+            _run_one(eng, _prompt(8, 700 + i),
+                     SamplingParams(max_new_tokens=2, seed=i))
+        return eng
+
+    f32_pages = 2 + cfg.block_size // 8        # minimum legal pool: 10
+    int8_pages = 1 + (f32_pages - 1) * 4       # equal payload bytes: 37
+    f32_eng = arm(cfg, params, f32_pages)
+    int8_eng = arm(qcfg, qparams, int8_pages)
+    f32_bytes = f32_eng.kv_pool_bytes()
+    int8_bytes = int8_eng.kv_pool_bytes()
+    assert int8_bytes["payload"] <= f32_bytes["payload"]
+    assert f32_bytes["scales"] == 0 and int8_bytes["scales"] > 0
+    assert (int8_eng.stats.kv_blocks_cached
+            >= 4 * f32_eng.stats.kv_blocks_cached), (
+        int8_eng.stats.kv_blocks_cached, f32_eng.stats.kv_blocks_cached)
+    assert (int8_eng.kv_blocks_capacity_effective
+            == 4 * (int8_pages - 1)
+            > f32_eng.kv_blocks_capacity_effective)
+
+
+def test_f32_vs_int8_divergence_measured_separately(setup):
+    """The quality observable: f32 and int8 streams MAY diverge (that is
+    the honest cost of the codec) — what is pinned is that each stream
+    equals its OWN reference and the divergence is a measurement, not an
+    exactness failure."""
+    cfg, model, params = setup
+    qcfg, qparams = _quant(setup, weights_dtype="int8", kv_dtype="int8")
+    prompt = _prompt(12, 131)
+    kw = dict(temperature=0.9, top_k=7, seed=9)
+    ref_f32 = generate_fast(params, cfg, prompt[None], 16,
+                            **kw)[0, 12:].tolist()
+    ref_q = generate_fast(qparams, qcfg, prompt[None], 16,
+                          **kw)[0, 12:].tolist()
+    eng = InferenceEngine(qparams, qcfg, num_slots=2, paged=True,
+                          page_size=8)
+    got = _run_one(eng, prompt, SamplingParams(max_new_tokens=16, **kw))
+    assert got == ref_q                       # exact vs OWN reference
+    div = sum(a != b for a, b in zip(got, ref_f32)) / len(got)
+    assert 0.0 <= div <= 1.0                  # measured, never asserted 0
+
+
+def test_engine_rejects_bad_quant_dtypes(setup):
+    cfg, model, params = setup
+    with pytest.raises(ValueError, match="weights_dtype"):
+        InferenceEngine(params, dataclasses.replace(
+            cfg, weights_dtype="fp8"), num_slots=1)
+    with pytest.raises(ValueError, match="kv_dtype"):
+        InferenceEngine(params, dataclasses.replace(
+            cfg, kv_dtype="int4"), num_slots=1)
+
+
+def test_metrics_quant_columns_and_old_header_tolerance(setup, tmp_path):
+    """serve.csv engine rows + headline + read_headline carry
+    weights_dtype/kv_dtype; a pre-quantization CSV (old header) still
+    aggregates — pinned like the paging and fleet schema bumps."""
+    qcfg, qparams = _quant(setup, weights_dtype="int8", kv_dtype="int8")
+    eng = InferenceEngine(qparams, qcfg, num_slots=2, paged=True,
+                          page_size=8)
+    metrics = ServeMetrics(str(tmp_path), engine_log_every=1)
+    sched = Scheduler(eng, max_queue=8, metrics=metrics)
+    h = sched.submit(_prompt(6, 41), SamplingParams(max_new_tokens=3))
+    while h.status in (RequestStatus.QUEUED, RequestStatus.RUNNING):
+        sched.step()
+        metrics.engine_tick(eng.stats, queue_depth=sched.queue_depth())
+    metrics.sync()
+    head = metrics.headline()
+    assert head["weights_dtype"] == "int8"
+    assert head["kv_dtype"] == "int8"
+    csv_path = os.path.join(str(tmp_path), "serve.csv")
+    with open(csv_path) as f:
+        header = f.readline().strip().split(",")
+    assert "weights_dtype" in header and "kv_dtype" in header
+    post = read_headline(csv_path)
+    assert post["weights_dtype"] == "int8"
+    assert post["kv_dtype"] == "int8"
+    metrics.close()
+    # old-header CSV (pre-quantization schema): aggregates fine, dtypes
+    # simply absent
+    old = tmp_path / "old.csv"
+    old.write_text(
+        "ts_s,kind,request_id,status,queue_depth,active_slots,"
+        "prompt_tokens,new_tokens,ttft_s,avg_token_latency_s,"
+        "cum_tokens,tokens_per_s\n"
+        "0.5,request,r0,done,0,1,4,3,0.01,0.002,3,6.0\n")
+    legacy = read_headline(str(old))
+    assert legacy["requests_done"] == 1
+    assert legacy["weights_dtype"] is None
+    assert legacy["kv_dtype"] is None
