@@ -492,12 +492,16 @@ def _overlap_subprocess(timeout_s: int = 1800):
 
 
 def measure_network_sim() -> dict:
-    """The ISSUE 3 rider, grown by ISSUE 10: the low-communication
-    strategy family vs AllReduce in simulated wall-clock on the WAN and
-    datacenter presets — a tiny real sweep (measured compute, modeled
-    comm) through ``gym_tpu.sim.sweep``. Per preset, each strategy's
+    """The ISSUE 3 rider, grown by ISSUE 10 and ISSUE 12: the
+    low-communication strategy family — now codec × outer loop — vs
+    AllReduce in simulated wall-clock on the WAN, datacenter and
+    federated presets, via a tiny real sweep (measured compute, modeled
+    comm) through ``gym_tpu.sim.sweep``. Per preset, each cell's
     simulated speedup over AllReduce plus whether every cell's declared
-    trace reconciled with its logged ``cum_comm_bytes``."""
+    trace reconciled with its logged ``cum_comm_bytes``; the federated
+    preset carries the ISSUE 12 headline key
+    ``compressed_gossip_speedup`` (best NoLoCo × non-dense-codec
+    cell)."""
     import contextlib
     import tempfile
 
@@ -506,8 +510,10 @@ def measure_network_sim() -> dict:
     out = (os.environ.get("GYM_TPU_BENCH_SIM_DIR")
            or tempfile.mkdtemp(prefix="gym_tpu_sim_bench_"))
     cfg = SweepConfig(
-        strategies=["diloco", "noloco", "dynamiq_int8", "simple_reduce"],
-        presets=["wan", "datacenter"],
+        strategies=["diloco", "noloco", "demo_outer", "dynamiq_int8",
+                    "simple_reduce"],
+        presets=["wan", "datacenter", "federated"],
+        codecs=["dense", "int8", "int4"],
         nodes=[int(os.environ.get("GYM_TPU_BENCH_SIM_NODES", 4))],
         H=[int(os.environ.get("GYM_TPU_BENCH_SIM_H", 10))],
         steps=int(os.environ.get("GYM_TPU_BENCH_SIM_STEPS", 30)),
@@ -516,23 +522,36 @@ def measure_network_sim() -> dict:
     with contextlib.redirect_stdout(sys.stderr):  # keep stdout one JSON line
         rows = run_sweep(cfg)
 
-    def cell(strategy, preset):
+    def cell(strategy, preset, codec=None):
         return next(r for r in rows if r["strategy"] == strategy
-                    and r["topology"] == preset)
+                    and r["topology"] == preset
+                    and r.get("codec") == codec)
 
     result = {"metric": "network_sim_low_comm_vs_allreduce",
               "status": "measured",
               "measured": True,
               "workload": (f"2-layer GPT, {cfg.nodes[0]} nodes, "
-                           f"{cfg.steps} steps, H={cfg.H[0]}, int8"),
+                           f"{cfg.steps} steps, H={cfg.H[0]}, "
+                           f"codecs {'+'.join(cfg.codecs)}"),
               "out_dir": out}
     for preset in cfg.presets:
         a = cell("simple_reduce", preset)
         entry = {"allreduce_sim_s": round(a["sim_total_s"], 3),
                  "traces_reconcile": bool(a["reconciled"])}
-        for name, key in (("diloco", "diloco"), ("noloco", "noloco"),
-                          ("dynamiq", "dynamiq_int8")):
-            r = cell(name, preset)
+        # every (strategy, codec) cell the grid runs is reported — a
+        # trained-but-unreported cell would be wasted fit time
+        for name, key, codec in (
+                ("diloco", "diloco", None),
+                ("diloco", "diloco_int8", "int8"),
+                ("diloco", "diloco_int4", "int4"),
+                ("noloco", "noloco", None),
+                ("noloco", "noloco_int8", "int8"),
+                ("noloco", "noloco_int4", "int4"),
+                ("demo_outer", "demo_outer", None),
+                ("demo_outer", "demo_outer_int8", "int8"),
+                ("demo_outer", "demo_outer_int4", "int4"),
+                ("dynamiq", "dynamiq_int8", "int8")):
+            r = cell(name, preset, codec)
             entry[f"{key}_sim_s"] = round(r["sim_total_s"], 3)
             entry[f"{key}_speedup"] = (
                 round(a["sim_total_s"] / r["sim_total_s"], 2)
@@ -542,6 +561,12 @@ def measure_network_sim() -> dict:
         # back-compat key: r03-era artifacts called this "speedup"
         entry["speedup"] = entry["diloco_speedup"]
         result[preset] = entry
+    # the ISSUE 12 headline: best compressed-gossip cell on the
+    # federated preset, end to end vs AllReduce
+    fed = result.get("federated", {})
+    result["compressed_gossip_speedup"] = max(
+        (fed[k] for k in ("noloco_int8_speedup", "noloco_int4_speedup")
+         if fed.get(k)), default=None)
     return result
 
 

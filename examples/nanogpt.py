@@ -29,7 +29,8 @@ import numpy as np
 from gym_tpu import Trainer
 from gym_tpu.data import ContiguousGPTTrainDataset, get_dataset
 from gym_tpu.models.nanogpt import GPT, GPTConfig
-from gym_tpu.strategy import (DeMoStrategy, DiLoCoStrategy, DynamiQStrategy,
+from gym_tpu.strategy import (DecoupledMomentumStrategy, DeMoStrategy,
+                              DiLoCoStrategy, DynamiQStrategy,
                               FedAvgStrategy, NoLoCoStrategy, OptimSpec,
                               SimpleReduceStrategy, SPARTADiLoCoStrategy,
                               SPARTAStrategy, ZeroReduceStrategy)
@@ -39,11 +40,21 @@ def gen_run_name(args) -> str:
     """Run-name generator (reference ``example/nanogpt.py:9-28``)."""
     parts = [args.dataset, args.model_size, args.strategy,
              f"{args.num_nodes}n", f"bs{args.batch_size}"]
-    if args.strategy in ("diloco", "diloco_sparta", "noloco"):
+    if args.strategy in ("diloco", "diloco_sparta", "noloco",
+                         "demo_outer"):
         parts.append(f"H{args.diloco_interval}")
     if args.strategy in ("sparta", "diloco_sparta"):
         parts.append(f"p{args.p_sparta}")
     if args.strategy == "dynamiq":
+        parts.append(args.codec or "int8")
+    elif args.strategy == "demo_outer":
+        # the default link is top-k (create_strategy) — name it so the
+        # default run and an explicit --codec topk run share a run dir
+        codec = args.codec or "topk"
+        if codec != "dense":
+            parts.append(codec)
+    elif (args.strategy in ("diloco", "noloco")
+            and args.codec not in (None, "dense")):
         parts.append(args.codec)
     if getattr(args, "participation", 1.0) < 1.0:
         parts.append(f"part{args.participation}")
@@ -82,6 +93,10 @@ def create_strategy(args):
         return FedAvgStrategy(inner_optim=optim, H=args.H,
                               island_size=args.island_size,
                               participation=args.participation, **sched)
+    # the CompressedLink codec axis (ISSUE 12): shared by diloco /
+    # noloco / demo_outer; "dense" (or unset) is the identity link
+    link_codec = None if args.codec in (None, "dense") else args.codec
+    link_kw = ({"frac": args.topk_frac} if link_codec == "topk" else {})
     if args.strategy == "diloco":
         return DiLoCoStrategy(
             optim_spec=optim,
@@ -89,7 +104,8 @@ def create_strategy(args):
                 "sgd", lr=args.outer_lr, nesterov=args.nesterov,
                 momentum=args.outer_momentum),
             H=args.diloco_interval,
-            participation=args.participation, **sched)
+            participation=args.participation,
+            codec=link_codec, **link_kw, **sched)
     if args.strategy == "sparta":
         return SPARTAStrategy(inner_optim=optim, p_sparta=args.p_sparta,
                               interval=args.sparta_interval,
@@ -118,12 +134,27 @@ def create_strategy(args):
             outer_optim_spec=OptimSpec(
                 "sgd", lr=args.outer_lr, nesterov=args.nesterov,
                 momentum=args.outer_momentum),
-            H=args.diloco_interval, **sched)
+            H=args.diloco_interval,
+            codec=link_codec, **link_kw, **sched)
+    if args.strategy == "demo_outer":
+        # decoupled outer momentum (arXiv 2510.03371; strategy/demo.py):
+        # --codec defaults to the DeMo-style top-k extraction
+        codec = link_codec or ("topk" if args.codec is None else None)
+        ckw = {"frac": args.topk_frac} if codec == "topk" else {}
+        return DecoupledMomentumStrategy(
+            optim_spec=optim, H=args.diloco_interval,
+            outer_lr=args.outer_lr, outer_momentum=args.outer_momentum,
+            codec=codec, **ckw, **sched)
     if args.strategy == "dynamiq":
         # compressed all-reduce: DDP sync pattern, codec'd payloads
         # (see strategy/dynamiq.py)
-        kw = {"frac": args.topk_frac} if args.codec == "topk" else {}
-        return DynamiQStrategy(optim_spec=optim, codec=args.codec,
+        if args.codec == "dense":
+            raise SystemExit(
+                "dynamiq is compressed by definition — --codec dense "
+                "is plain DDP; use --strategy base instead")
+        codec = args.codec or "int8"
+        kw = {"frac": args.topk_frac} if codec == "topk" else {}
+        return DynamiQStrategy(optim_spec=optim, codec=codec,
                                **kw, **sched)
     raise ValueError(f"unknown strategy {args.strategy}")
 
@@ -161,7 +192,8 @@ def main():
     # strategy (:77-133)
     p.add_argument("--strategy", default="base",
                    choices=["base", "zero", "fedavg", "diloco", "sparta",
-                            "diloco_sparta", "demo", "noloco", "dynamiq"])
+                            "diloco_sparta", "demo", "noloco", "dynamiq",
+                            "demo_outer"])
     p.add_argument("--H", type=int, default=1)
     p.add_argument("--island_size", type=int, default=None)
     p.add_argument("--p_sparta", type=float, default=0.005)
@@ -175,9 +207,12 @@ def main():
     p.add_argument("--compression_decay", type=float, default=0.999)
     p.add_argument("--compression_topk", type=int, default=32)
     p.add_argument("--compression_chunk", type=int, default=64)
-    p.add_argument("--codec", default="int8",
-                   choices=["int8", "int4", "topk"],
-                   help="dynamiq payload codec (strategy/compress.py)")
+    p.add_argument("--codec", default=None,
+                   choices=["dense", "int8", "int4", "topk"],
+                   help="outer-loop payload codec (strategy/compress.py "
+                        "CompressedLink): diloco/noloco/demo_outer "
+                        "default dense (demo_outer: topk), dynamiq "
+                        "defaults int8")
     p.add_argument("--topk_frac", type=float, default=0.01,
                    help="kept fraction for --codec topk")
     # TPU-native additions

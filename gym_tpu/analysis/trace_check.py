@@ -43,10 +43,14 @@ Three emulation escape hatches, each gated on level 2 holding exactly:
   draw, folded out of a jaxpr at the concrete step (a trace lying about
   the partner map fails even though the byte totals agree).
 
-``check_all_strategies`` covers the 9 shipped strategies (zero_reduce
-and DynamiQ each in both their canonical flat-vector schedule and their
-vnode fallback, DynamiQ also in its top-k/error-feedback config) and is
-the CI gate every future strategy PR must extend and pass.
+``check_all_strategies`` covers the 10 shipped strategies in 16
+configurations (zero_reduce and DynamiQ each in both their canonical
+flat-vector schedule and their vnode fallback, DynamiQ also in its
+top-k/error-feedback config, plus the ISSUE 12 compressed outer loops —
+DiLoCo int8/top-k, NoLoCo int4 and the decoupled-momentum outer
+variant, whose CompressedLink wire bytes all reconcile under their
+declared ``emulated_bytes`` dense bounds) and is the CI gate every
+future strategy PR must extend and pass.
 """
 
 from __future__ import annotations
@@ -398,11 +402,16 @@ def check_strategy(strategy: Strategy, params_template: PyTree = None,
 
 
 def default_strategy_suite() -> Dict[str, Strategy]:
-    """The 9 shipped strategies in their reconciliation configurations
+    """The 10 shipped strategies in their reconciliation configurations
     (zero_reduce and dynamiq appear twice: canonical flat-vector
     schedule and the vnode pmean+slice fallback — both must reconcile;
-    dynamiq a third time in its top-k/error-feedback config)."""
-    from ..strategy import (DeMoStrategy, DiLoCoStrategy, DynamiQStrategy,
+    dynamiq a third time in its top-k/error-feedback config; the
+    ISSUE 12 codec axis adds the compressed outer loops — DiLoCo int8 +
+    top-k, NoLoCo int4, and the decoupled-momentum outer variant —
+    every one of which must declare its codec's honest wire bytes and
+    stay inside its ``emulated_bytes`` dense bound)."""
+    from ..strategy import (DecoupledMomentumStrategy, DeMoStrategy,
+                            DiLoCoStrategy, DynamiQStrategy,
                             FedAvgStrategy, NoLoCoStrategy,
                             SimpleReduceStrategy, SPARTADiLoCoStrategy,
                             SPARTAStrategy, ZeroReduceStrategy)
@@ -419,6 +428,11 @@ def default_strategy_suite() -> Dict[str, Strategy]:
         "dynamiq": DynamiQStrategy(),                 # int8, canonical
         "dynamiq_vnode": DynamiQStrategy(),           # pmean fallback
         "dynamiq_topk": DynamiQStrategy(codec="topk", frac=0.05),
+        # ISSUE 12: codec × outer-loop compositions
+        "diloco_int8": DiLoCoStrategy(H=5, codec="int8"),
+        "diloco_topk": DiLoCoStrategy(H=5, codec="topk", frac=0.05),
+        "noloco_int4": NoLoCoStrategy(H=4, codec="int4"),
+        "demo_outer": DecoupledMomentumStrategy(H=4, frac=0.05),
     }
 
 

@@ -39,20 +39,28 @@ import os
 from typing import Any, Dict, List, Optional
 
 # strategies that take a sync-interval H
-_H_STRATEGIES = ("diloco", "fedavg", "diloco_sparta", "noloco")
-# strategies that take a quantization bit-width (the compressed
-# all-reduce family)
+_H_STRATEGIES = ("diloco", "fedavg", "diloco_sparta", "noloco",
+                 "demo_outer")
+# outer-loop strategies whose CompressedLink takes the --codecs axis
+# (ISSUE 12: codec × outer loop is orthogonal — "dense" is the identity
+# link)
+_CODEC_STRATEGIES = ("diloco", "noloco", "demo_outer")
+# strategies that are compressed BY DEFINITION (the dense cell is just
+# simple_reduce): they take the non-dense codecs + the legacy --bits axis
 _BITS_STRATEGIES = ("dynamiq",)
+_KNOWN_CODECS = ("dense", "int8", "int4", "topk")
 _STRATEGY_ALIASES = {
     "base": "simple_reduce", "allreduce": "simple_reduce",
     "zero": "zero_reduce", "sparta_diloco": "diloco_sparta",
     "dynamiq_int8": "dynamiq", "dynamiq_int4": "dynamiq",
+    "decoupled_momentum": "demo_outer",
 }
-# aliases that NAME a bit-width pin it: `dynamiq_int8` runs int8 cells
-# whatever --bits says (the bare `dynamiq` name takes the --bits axis)
-_ALIAS_PINNED_BITS = {"dynamiq_int8": 8, "dynamiq_int4": 4}
+# aliases that NAME a codec pin it: `dynamiq_int8` runs int8 cells
+# whatever --bits/--codecs say (the bare `dynamiq` name takes the axes)
+_ALIAS_PINNED_CODEC = {"dynamiq_int8": "int8", "dynamiq_int4": "int4"}
 STRATEGIES = ("simple_reduce", "zero_reduce", "diloco", "fedavg",
-              "sparta", "diloco_sparta", "demo", "noloco", "dynamiq")
+              "sparta", "diloco_sparta", "demo", "noloco", "dynamiq",
+              "demo_outer")
 
 
 @dataclasses.dataclass
@@ -62,6 +70,8 @@ class SweepConfig:
     nodes: List[int]
     H: List[int]
     bits: List[int] = dataclasses.field(default_factory=lambda: [8])
+    codecs: List[str] = dataclasses.field(default_factory=lambda: ["dense"])
+    topk_frac: float = 0.05
     steps: int = 30
     batch_size: int = 8
     block_size: int = 64
@@ -75,9 +85,9 @@ class SweepConfig:
     out: str = os.path.join("logs", "sim_sweep")
 
     def __post_init__(self):
-        # (resolved name, pinned bit-width or None) per requested entry
+        # (resolved name, pinned codec or None) per requested entry
         self._strategy_entries = [
-            (_STRATEGY_ALIASES.get(s, s), _ALIAS_PINNED_BITS.get(s))
+            (_STRATEGY_ALIASES.get(s, s), _ALIAS_PINNED_CODEC.get(s))
             for s in self.strategies]
         self.strategies = [name for name, _ in self._strategy_entries]
         for s in self.strategies:
@@ -87,6 +97,10 @@ class SweepConfig:
         for b in self.bits:
             if b not in (4, 8):
                 raise ValueError(f"unknown bit-width {b!r}; known: 4, 8")
+        for c in self.codecs:
+            if c not in _KNOWN_CODECS:
+                raise ValueError(f"unknown codec {c!r}; "
+                                 f"known: {_KNOWN_CODECS}")
         if self.checkpoint_interval <= 0:
             self.checkpoint_interval = max(2, self.steps // 3)
 
@@ -97,20 +111,29 @@ class Cell:
     H: Optional[int]      # None for strategies without a sync interval
     nodes: int
     preset: str
-    bits: Optional[int] = None   # None for uncompressed strategies
+    codec: Optional[str] = None   # None = dense / codec-free strategy
 
     @property
     def cell_id(self) -> str:
         h = f"_H{self.H}" if self.H is not None else ""
-        b = f"_int{self.bits}" if self.bits is not None else ""
-        return f"{self.strategy}{h}{b}_n{self.nodes}_{self.preset}"
+        c = f"_{self.codec}" if self.codec is not None else ""
+        return f"{self.strategy}{h}{c}_n{self.nodes}_{self.preset}"
+
+    @property
+    def bits(self) -> Optional[int]:
+        """Legacy bit-width view of the codec axis (results.csv
+        back-compat: r03-era artifacts carried `bits`)."""
+        return {"int8": 8, "int4": 4}.get(self.codec)
 
 
 def grid(cfg: SweepConfig) -> List[Cell]:
-    """The deduplicated cell grid: H and bits only multiply strategies
-    that consume them; a bit-pinned alias (`dynamiq_int8`) contributes
-    exactly its named cell, and a cell requested twice (e.g. `dynamiq`
-    with --bits 8 plus `dynamiq_int8`) runs once."""
+    """The deduplicated cell grid: H, --codecs and --bits only multiply
+    strategies that consume them — the CompressedLink family (diloco,
+    noloco, demo_outer) takes the full codec axis incl. "dense", the
+    definitionally-compressed dynamiq takes the non-dense codecs plus
+    the legacy --bits widths. A codec-pinned alias (`dynamiq_int8`)
+    contributes exactly its named cell, and a cell requested twice
+    runs once."""
     cells: List[Cell] = []
     seen: set = set()
     for preset in cfg.presets:
@@ -118,12 +141,19 @@ def grid(cfg: SweepConfig) -> List[Cell]:
             for s, pinned in cfg._strategy_entries:
                 hs = cfg.H if s in _H_STRATEGIES else [None]
                 if s in _BITS_STRATEGIES:
-                    bs = [pinned] if pinned is not None else cfg.bits
+                    if pinned is not None:
+                        cs: List[Optional[str]] = [pinned]
+                    else:
+                        cs = [f"int{b}" for b in cfg.bits]
+                        cs += [c for c in cfg.codecs
+                               if c != "dense" and c not in cs]
+                elif s in _CODEC_STRATEGIES:
+                    cs = [None if c == "dense" else c for c in cfg.codecs]
                 else:
-                    bs = [None]
+                    cs = [None]
                 for h in hs:
-                    for b in bs:
-                        cell = Cell(s, h, n, preset, b)
+                    for c in cs:
+                        cell = Cell(s, h, n, preset, c)
                         if cell.cell_id not in seen:
                             seen.add(cell.cell_id)
                             cells.append(cell)
@@ -131,18 +161,21 @@ def grid(cfg: SweepConfig) -> List[Cell]:
 
 
 def make_strategy(name: str, H: Optional[int], lr: float,
-                  bits: Optional[int] = None):
-    from ..strategy import (DeMoStrategy, DiLoCoStrategy, DynamiQStrategy,
+                  codec: Optional[str] = None, topk_frac: float = 0.05):
+    from ..strategy import (DecoupledMomentumStrategy, DeMoStrategy,
+                            DiLoCoStrategy, DynamiQStrategy,
                             FedAvgStrategy, NoLoCoStrategy, OptimSpec,
                             SimpleReduceStrategy, SPARTADiLoCoStrategy,
                             SPARTAStrategy, ZeroReduceStrategy)
     optim = OptimSpec("adamw", lr=lr)
+    codec = None if codec == "dense" else codec
+    ckw = {"frac": topk_frac} if codec == "topk" else {}
     if name == "simple_reduce":
         return SimpleReduceStrategy(optim_spec=optim)
     if name == "zero_reduce":
         return ZeroReduceStrategy(optim_spec=optim)
     if name == "diloco":
-        return DiLoCoStrategy(optim_spec=optim, H=H)
+        return DiLoCoStrategy(optim_spec=optim, H=H, codec=codec, **ckw)
     if name == "fedavg":
         return FedAvgStrategy(inner_optim=optim, H=H)
     if name == "sparta":
@@ -153,10 +186,13 @@ def make_strategy(name: str, H: Optional[int], lr: float,
         from ..strategy import OptimSpec as _OS
         return DeMoStrategy(optim_spec=_OS("sgd", lr=lr))
     if name == "noloco":
-        return NoLoCoStrategy(optim_spec=optim, H=H)
+        return NoLoCoStrategy(optim_spec=optim, H=H, codec=codec, **ckw)
+    if name == "demo_outer":
+        return DecoupledMomentumStrategy(optim_spec=optim, H=H,
+                                         codec=codec, **ckw)
     if name == "dynamiq":
-        return DynamiQStrategy(optim_spec=optim,
-                               codec=f"int{bits or 8}")
+        return DynamiQStrategy(optim_spec=optim, codec=codec or "int8",
+                               **ckw)
     raise ValueError(name)
 
 
@@ -238,7 +274,8 @@ def run_cell(cell: Cell, cfg: SweepConfig) -> Dict[str, Any]:
     from .. import Trainer
 
     model, ds = _workload(cfg, cell.nodes)
-    strategy = make_strategy(cell.strategy, cell.H, cfg.lr, cell.bits)
+    strategy = make_strategy(cell.strategy, cell.H, cfg.lr, cell.codec,
+                             cfg.topk_frac)
     run_dir = os.path.join(cfg.out, "logs", cell.cell_id)
     res = Trainer(model, ds).fit(
         strategy=strategy,
@@ -293,6 +330,7 @@ def run_cell(cell: Cell, cfg: SweepConfig) -> Dict[str, Any]:
         "cell": cell.cell_id,
         "strategy": cell.strategy,
         "H": cell.H,
+        "codec": cell.codec,
         "bits": cell.bits,
         "nodes": cell.nodes,
         "topology": cell.preset,
@@ -338,13 +376,23 @@ def _baseline_of(rows: List[Dict[str, Any]], row) -> Optional[Dict]:
     return None
 
 
+def _row_codec(r: Dict[str, Any]) -> Optional[str]:
+    """The cell's codec, tolerating r03-era cached rows that only
+    carried `bits`."""
+    codec = r.get("codec")
+    if codec is None:
+        codec = {8: "int8", 4: "int4"}.get(r.get("bits"))
+    return codec
+
+
 def _config_label(r: Dict[str, Any]) -> str:
     """Human label for one cell's strategy configuration."""
     label = r["strategy"]
     if r.get("H") is not None:
         label += f" H={r['H']}"
-    if r.get("bits") is not None:
-        label += f" int{r['bits']}"
+    codec = _row_codec(r)
+    if codec is not None:
+        label += f" {codec}"
     return label
 
 
@@ -388,6 +436,7 @@ def write_frontier_csv(path: str, rows: List[Dict[str, Any]]) -> None:
                 "topology": preset, "nodes": n,
                 "config": _config_label(r),
                 "strategy": r["strategy"], "H": r.get("H"),
+                "codec": _row_codec(r),
                 "bits": r.get("bits"),
                 "sim_total_s": r["sim_total_s"],
                 "sim_comm_s": r["sim_comm_s"],
@@ -416,7 +465,7 @@ def write_report(rows: List[Dict[str, Any]], cfg: SweepConfig) -> str:
                 continue
             lines.append(f"## {preset} × {n} nodes")
             lines.append("")
-            lines.append("| strategy | H | bits | sim wall-clock (s) | "
+            lines.append("| strategy | H | codec | sim wall-clock (s) | "
                          "sim comm (s) | vs AllReduce | comm/node (MB) | "
                          "final loss | trace reconciles |")
             lines.append("|---|---|---|---|---|---|---|---|---|")
@@ -425,11 +474,12 @@ def write_report(rows: List[Dict[str, Any]], cfg: SweepConfig) -> str:
                 speed = (base["sim_total_s"] / r["sim_total_s"]
                          if base and r["sim_total_s"] else None)
                 if (headline is None and preset == "wan"
-                        and r["strategy"] == "diloco" and speed):
+                        and r["strategy"] == "diloco"
+                        and _row_codec(r) is None and speed):
                     headline = (r, base, speed)
                 lines.append(
                     f"| {r['strategy']} | {r['H'] or '—'} "
-                    f"| {r.get('bits') or '—'} "
+                    f"| {_row_codec(r) or 'dense'} "
                     f"| {r['sim_total_s']:.2f} | {r['sim_comm_s']:.2f} "
                     f"| {f'{speed:.1f}x' if speed else '—'} "
                     f"| {r['cum_comm_bytes'] / 1e6:.2f} "
@@ -479,7 +529,8 @@ def _workload_sig(cfg: SweepConfig) -> Dict[str, Any]:
     only valid under the same workload."""
     return {k: getattr(cfg, k) for k in (
         "steps", "batch_size", "block_size", "n_layer", "n_head",
-        "n_embd", "lr", "seed", "overlap", "checkpoint_interval")}
+        "n_embd", "lr", "seed", "overlap", "checkpoint_interval",
+        "topk_frac")}
 
 
 def _invalidate_if_stale(out: str, sig: Dict[str, Any]) -> bool:
@@ -563,6 +614,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--bits", default="8",
                    help="comma list of quantization bit-widths for the "
                         "compressed strategies (dynamiq): 8, 4")
+    p.add_argument("--codecs", default="dense",
+                   help="comma list of outer-loop codecs for the "
+                        "CompressedLink family (diloco, noloco, "
+                        "demo_outer; non-dense entries also multiply "
+                        "dynamiq): dense, int8, int4, topk")
+    p.add_argument("--topk_frac", type=float, default=0.05,
+                   help="kept fraction for the topk codec cells")
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--batch_size", type=int, default=8)
     p.add_argument("--block_size", type=int, default=64)
@@ -592,6 +650,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         nodes=[int(x) for x in _csv_list(args.nodes)],
         H=[int(x) for x in _csv_list(args.H)],
         bits=[int(x) for x in _csv_list(args.bits)],
+        codecs=_csv_list(args.codecs),
+        topk_frac=args.topk_frac,
         steps=args.steps, batch_size=args.batch_size,
         block_size=args.block_size, n_layer=args.n_layer,
         n_head=max(1, args.n_embd // 32), n_embd=args.n_embd,

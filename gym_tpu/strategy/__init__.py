@@ -9,8 +9,10 @@ reference's re-exports — SURVEY §2.1).
 from .base import CollectiveEvent, Strategy, StrategyLifecycleError
 from .communicate_optimize import (CommunicateOptimizeStrategy,
                                    CommunicationModule)
-from .compress import Codec, QuantizeCodec, TopKCodec, make_codec
-from .demo import DeMoStrategy
+from .compress import (Codec, CompressedLink, QuantizeCodec, TopKCodec,
+                       link_key, make_codec)
+from .demo import (DecoupledMomentumStrategy, DeMoOuterCommunicator,
+                   DeMoStrategy)
 from .diloco import DiLoCoCommunicator, DiLoCoStrategy
 from .dynamiq import DynamiQStrategy
 from .faults import alive_mask, masked_mean, participation_round
@@ -46,12 +48,16 @@ __all__ = [
     "PartitionedIndexSelector",
     "SPARTADiLoCoStrategy",
     "DeMoStrategy",
+    "DecoupledMomentumStrategy",
+    "DeMoOuterCommunicator",
     "NoLoCoStrategy",
     "NoLoCoCommunicator",
     "DynamiQStrategy",
     "Codec",
+    "CompressedLink",
     "QuantizeCodec",
     "TopKCodec",
+    "link_key",
     "make_codec",
     "alive_mask",
     "masked_mean",

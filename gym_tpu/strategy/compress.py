@@ -23,7 +23,12 @@ any strategy that wants it:
   matches the declaration byte-for-byte;
 - top-k error feedback is the STRATEGY's job (the residual is training
   state, not codec state): ``Codec.error_feedback`` just says whether the
-  strategy should carry one.
+  strategy should carry one;
+- ``CompressedLink`` (ISSUE 12) packages the codec + the error-feedback
+  recursion + the ``link_key`` discipline into the one wire path every
+  outer-loop strategy shares — DiLoCo outer deltas, NoLoCo gossip
+  exchanges, decoupled-momentum all-reduces and DynamiQ's two hops all
+  compress through it.
 
 Pure functions over arrays — unit-tested round-trip in
 ``tests/test_compress.py`` (error decays under error feedback, bit-exact
@@ -34,7 +39,7 @@ from __future__ import annotations
 
 import abc
 import dataclasses
-from typing import Any, Dict, Tuple, Union
+from typing import Any, Dict, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -209,3 +214,135 @@ def hop_keys(seed: int, step, n_hops: int = 2):
     inside jit and with a concrete one on the host."""
     key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
     return jax.random.split(key, n_hops)
+
+
+def link_key(seed: int, step, hop: int = 0, node=None):
+    """The ``CompressedLink`` key derivation: fold the base seed with the
+    step, then the hop index, then (for hops where each node compresses
+    its OWN payload — gossip exchanges, per-node outer deltas) the node
+    index. The chain guarantees no key is ever reused between hops of one
+    step or between gossip partners within a step, while staying fully
+    deterministic from ``(seed, step, hop, node)`` alone — two runs of
+    the same seed produce bit-identical compressed exchanges, and the
+    host trace can replay any key without communication. ``step`` and
+    ``node`` may be traced (inside jit) or concrete (host twin)."""
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+    key = jax.random.fold_in(key, hop)
+    if node is not None:
+        key = jax.random.fold_in(key, node)
+    return key
+
+
+class CompressedLink:
+    """One outer-loop communication hop as compress → wire → decompress.
+
+    The orthogonal-composition layer (ISSUE 12): any strategy that ships
+    a flat f32 payload over the (emulated) wire — DiLoCo's outer delta,
+    NoLoCo's gossip exchange, the decoupled-momentum all-reduce,
+    DynamiQ's two all-reduce hops — wraps the payload in a link instead
+    of calling codecs inline, and gets for free:
+
+    - **codec dispatch** incl. the dense passthrough (``codec=None`` /
+      ``"dense"``): an uncompressed strategy is the same code path with
+      an identity link, so ``codec`` becomes a config axis, not a fork;
+    - **persistent error-feedback residual** (Stich et al. 1809.07599):
+      ``encode`` adds the residual to the payload before compression and
+      returns the new residual (``send − delivered``) for the strategy
+      to carry in its STATE — training state, sharded/replicated like
+      the params, checkpointed and restored across ``fit(resume=...)``
+      with everything else. Default ON for every lossy codec (aggressive
+      int4/top-k outer deltas do not converge without it — the ablation
+      is test-asserted); ``error_feedback=False`` is the ablation knob;
+    - **key discipline** (``link_key``): per-step, per-hop, per-node
+      rounding keys derived from the strategy's base seed — no key reuse
+      between gossip partners within a step, bit-reproducible across
+      runs;
+    - **honest wire accounting**: ``wire_bytes(n)`` is what the owning
+      strategy's ``comm_events`` declares (and its jitted ``comm_bytes``
+      metric reports) while the SPMD emulation moves dense f32 — the
+      realized-vs-moved split the static verifier reconciles, with
+      ``emulated_bytes`` bounding the dense side.
+    """
+
+    def __init__(self, codec: Union[str, Codec, None] = None,
+                 seed: int = 0, error_feedback: Optional[bool] = None,
+                 **codec_kwargs):
+        if codec is None or codec == "dense":
+            if codec_kwargs:
+                raise ValueError(
+                    f"codec kwargs {sorted(codec_kwargs)} given for the "
+                    f"dense (identity) link")
+            self.codec: Optional[Codec] = None
+        else:
+            self.codec = make_codec(codec, **codec_kwargs)
+        self.seed = int(seed)
+        if error_feedback is None:
+            # EF default-on for every lossy codec: quantization's
+            # stochastic rounding is unbiased but its per-round variance
+            # still compounds through the outer loop; top-k is biased
+            # outright. The residual costs one f32 vector of state.
+            error_feedback = self.codec is not None
+        self.error_feedback = bool(error_feedback) and self.codec is not None
+
+    @property
+    def compressed(self) -> bool:
+        return self.codec is not None
+
+    # -- state ------------------------------------------------------------
+
+    def init(self, n: int) -> Dict[str, jnp.ndarray]:
+        """The link's contribution to the owning strategy's state: the
+        error-feedback residual (empty when the link carries none)."""
+        if not self.error_feedback:
+            return {}
+        return {"ef_residual": jnp.zeros((int(n),), jnp.float32)}
+
+    # -- keys -------------------------------------------------------------
+
+    def key(self, step, hop: int = 0, node=None):
+        """Per-(step, hop[, node]) rounding key — see ``link_key``."""
+        return link_key(self.seed, step, hop, node)
+
+    # -- the wire ---------------------------------------------------------
+
+    def encode(self, x: jnp.ndarray, residual, key):
+        """One payload through the link: ``(delivered, new_residual)``.
+
+        ``delivered`` is what the receiving end reconstructs (for the
+        dense link, ``x`` itself — the payload and its reconstruction
+        coincide). ``residual=None`` means the caller carries no
+        residual for this hop (dense link, or a strategy like decoupled
+        momentum whose momentum buffer IS the residual); otherwise the
+        EF recursion runs: ``send = x + residual``,
+        ``new_residual = send − delivered``."""
+        if self.codec is None:
+            return x, residual
+        send = x if residual is None else x + residual
+        x_hat = self.codec.roundtrip(send, key)
+        return x_hat, (None if residual is None else send - x_hat)
+
+    def send(self, x: jnp.ndarray, lstate: Dict[str, jnp.ndarray], key):
+        """Dict-state form of ``encode`` over the ``init`` layout: pulls
+        the residual out of ``lstate``, returns the delivered payload and
+        the updated ``lstate``."""
+        residual = lstate["ef_residual"] if self.error_feedback else None
+        x_hat, new_residual = self.encode(x, residual, key)
+        if not self.error_feedback:
+            return x_hat, lstate
+        return x_hat, dict(lstate, ef_residual=new_residual)
+
+    # -- accounting -------------------------------------------------------
+
+    def wire_bytes(self, n: int) -> float:
+        """Honest wire bytes for an ``n``-element payload: the codec's
+        accounting, or dense f32 for the identity link."""
+        if self.codec is None:
+            return 4.0 * n
+        return self.codec.wire_bytes(n)
+
+    def config(self) -> Dict[str, Any]:
+        if self.codec is None:
+            return {"codec": "dense"}
+        cfg = dict(self.codec.config())
+        cfg["link_error_feedback"] = self.error_feedback
+        return cfg

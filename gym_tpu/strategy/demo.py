@@ -29,13 +29,17 @@ from typing import List, Optional, Union
 
 import jax
 import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
 
 from ..ops.dct import (codec_for, decode_chunks, dct_matrix, encode_chunks,
                        sparse_decode_chunks)
 from ..ops.topk_compress import (mean_weights, scatter_mean_decode,
                                  topk_compress)
 from .base import (CollectiveEvent, PyTree, Strategy, comm_metric,
-                   require_finalized)
+                   require_finalized, tree_num_params)
+from .communicate_optimize import (CommunicateOptimizeStrategy,
+                                   CommunicationModule)
+from .compress import Codec, CompressedLink
 from .optim import OptimSpec, ensure_optim_spec
 from .sharding import pipe_unwrap, pipe_wrap
 
@@ -372,4 +376,144 @@ class DeMoStrategy(Strategy):
             "delta_dtype": str(jnp.dtype(self.delta_dtype))
                            if self.delta_dtype else "float32",
         })
+        return cfg
+
+
+class DeMoOuterCommunicator(CommunicationModule):
+    """Decoupled momentum at the OUTER cadence (arXiv 2510.03371).
+
+    DeMo (above) decouples momentum EVERY step: the momentum buffer
+    accumulates the gradient, only its codec-extracted fast component is
+    exchanged, and the slow components stay local forever. This module is
+    the same decoupling applied to the DiLoCo-shaped outer loop: the
+    inner optimizer runs locally every step, and every H steps
+
+    1. the outer velocity accumulates the OUTER pseudo-gradient:
+       ``m ← β·m + (params − master)``;
+    2. each node extracts the fast component ``q = C(m)`` through a
+       :class:`~.compress.CompressedLink` (top-k by default — the DeMo
+       choice — but any codec composes, including the dense identity,
+       whose limit at β=0, outer_lr=1 is plain parameter averaging) and
+       DECOUPLES it from the momentum: ``m ← m − q``. The momentum buffer
+       IS the error-feedback residual — dropped mass re-enters the next
+       round's extraction with interest β, so the link carries no
+       separate residual;
+    3. the fast components average across nodes (compressed all-reduce;
+       the emulation pmeans the dense reconstruction) and advance the
+       replicated master: ``master ← master + outer_lr·mean(q)``; params
+       sync to the master.
+
+    The master stays bit-identical on every node (identical init + the
+    pmean is a collective); only the momentum buffers are node-local —
+    which is exactly the decoupling: the slow per-node disagreement never
+    costs wire bytes.
+    """
+
+    def __init__(
+        self,
+        H: int = 10,
+        outer_lr: float = 0.7,
+        momentum: float = 0.9,
+        codec: Union[str, Codec, None] = "topk",
+        seed: int = 2510,   # arXiv 2510.03371
+        **codec_kwargs,
+    ):
+        if H < 1:
+            raise ValueError(f"H must be >= 1, got {H}")
+        if not 0.0 <= momentum < 1.0:
+            raise ValueError(f"momentum must be in [0, 1), got {momentum}")
+        self.H = int(H)
+        self.outer_lr = float(outer_lr)
+        self.momentum = float(momentum)
+        # EF explicitly OFF: the momentum buffer is the residual (step 2)
+        self.link = CompressedLink(codec, seed=seed, error_feedback=False,
+                                   **codec_kwargs)
+
+    def init(self, params: PyTree) -> PyTree:
+        return {
+            "master": jax.tree.map(jnp.array, params),
+            "momentum": jnp.zeros((tree_num_params(params),), jnp.float32),
+        }
+
+    def communicate(self, params, mstate, step, ctx):
+        k = ctx.num_nodes
+        if k <= 1:
+            return params, mstate, jnp.zeros(())
+
+        def sync(params, mstate):
+            flat_p, unravel = ravel_pytree(params)
+            flat_m, _ = ravel_pytree(mstate["master"])
+            m = (self.momentum * mstate["momentum"]
+                 + (flat_p.astype(jnp.float32)
+                    - flat_m.astype(jnp.float32)))
+            key = self.link.key(step, hop=0, node=ctx.node_index())
+            q, _ = self.link.encode(m, None, key)    # fast component
+            m = m - q                                # decoupled remainder
+            qbar = ctx.pmean(q)
+            master_flat = flat_m.astype(jnp.float32) + self.outer_lr * qbar
+            master = jax.tree.map(lambda a, p: a.astype(p.dtype),
+                                  unravel(master_flat), params)
+            comm = 2.0 * (k - 1) / k * self.link.wire_bytes(flat_p.size)
+            return (master, {"master": master, "momentum": m},
+                    jnp.asarray(comm, jnp.float32))
+
+        def skip(params, mstate):
+            return params, mstate, jnp.zeros(())
+
+        do = jnp.logical_and(step % self.H == 0, step > 0)
+        return jax.lax.cond(do, sync, skip, params, mstate)
+
+    def comm_events(self, step: int, params: PyTree,
+                    num_nodes: int) -> List[CollectiveEvent]:
+        if num_nodes <= 1 or not (step % self.H == 0 and step > 0):
+            return []
+        n = tree_num_params(params)
+        return [CollectiveEvent(
+            "all_reduce", self.link.wire_bytes(n), num_nodes,
+            label="outer_momentum_fast",
+            emulated_bytes=4.0 * n)]
+
+    def config(self):
+        cfg = {"module": "DeMoOuterCommunicator", "H": self.H,
+               "outer_lr": self.outer_lr,
+               "outer_momentum": self.momentum}
+        cfg.update(self.link.config())
+        return cfg
+
+
+class DecoupledMomentumStrategy(CommunicateOptimizeStrategy):
+    """Inner optimizer (default AdamW) + decoupled outer momentum
+    (arXiv 2510.03371; see :class:`DeMoOuterCommunicator`). The fourth
+    member of the low-communication outer-loop family — same knob
+    surface as DiLoCo/NoLoCo so the sweep swaps them against each
+    other, with the codec a first-class axis."""
+
+    def __init__(
+        self,
+        optim_spec: Optional[Union[str, OptimSpec]] = None,
+        H: int = 10,
+        outer_lr: float = 0.7,
+        outer_momentum: float = 0.9,
+        codec: Union[str, Codec, None] = "topk",
+        max_norm: Optional[float] = None,
+        lr_scheduler=None,
+        lr_scheduler_kwargs=None,
+        **codec_kwargs,
+    ):
+        self.H = int(H)
+        super().__init__(
+            communication_modules=[
+                DeMoOuterCommunicator(H=H, outer_lr=outer_lr,
+                                      momentum=outer_momentum,
+                                      codec=codec, **codec_kwargs)
+            ],
+            inner_optim=ensure_optim_spec(optim_spec, OptimSpec("adamw")),
+            max_norm=max_norm,
+            lr_scheduler=lr_scheduler,
+            lr_scheduler_kwargs=lr_scheduler_kwargs,
+        )
+
+    def config(self):
+        cfg = super().config()
+        cfg["H"] = self.H
         return cfg

@@ -26,11 +26,13 @@ from typing import List, Optional, Union
 import jax
 import jax.numpy as jnp
 import optax
+from jax.flatten_util import ravel_pytree
 
 from .base import (CollectiveEvent, PyTree, StrategyLifecycleError,
-                   tree_bytes)
+                   tree_bytes, tree_num_params)
 from .communicate_optimize import (CommunicateOptimizeStrategy,
                                    CommunicationModule)
+from .compress import Codec, CompressedLink
 from .optim import OptimSpec, ensure_optim_spec
 from .sharding import pipe_unwrap, pipe_wrap, take_shard, unshard
 
@@ -54,6 +56,10 @@ class DiLoCoCommunicator(CommunicationModule):
         shard_outer: bool = False,
         participation: float = 1.0,
         fault_seed: int = 5678,
+        codec: Union[str, Codec, None] = None,
+        codec_seed: int = 1206,
+        error_feedback: Optional[bool] = None,
+        **codec_kwargs,
     ):
         if not 0.0 < participation <= 1.0:
             raise ValueError(
@@ -71,6 +77,25 @@ class DiLoCoCommunicator(CommunicationModule):
         self.shard_outer = bool(shard_outer)
         self.participation = float(participation)
         self.fault_seed = fault_seed
+        # codec ORTHOGONAL to the outer loop (ISSUE 12): the outer
+        # DELTA (params − master) ships compressed through a
+        # CompressedLink with a per-node error-feedback residual carried
+        # in the module state. Restricted to the replicated outer state
+        # with full participation: a node-sharded master would also have
+        # to shard the residual reassembly, and a dead node's residual
+        # would silently freeze its error feedback — neither composition
+        # is honest enough to ship unverified.
+        self.link = CompressedLink(codec, seed=codec_seed,
+                                   error_feedback=error_feedback,
+                                   **codec_kwargs)
+        if self.link.compressed and self.shard_outer:
+            raise ValueError(
+                "codec cannot be combined with shard_outer=True: the "
+                "compressed outer delta needs the replicated outer state")
+        if self.link.compressed and self.participation < 1.0:
+            raise ValueError(
+                "codec cannot be combined with participation<1: a dead "
+                "node's error-feedback residual would silently freeze")
         self.outer_optim_spec = ensure_optim_spec(
             outer_optim_spec,
             OptimSpec("sgd", lr=0.7, nesterov=True, momentum=0.9),
@@ -82,6 +107,7 @@ class DiLoCoCommunicator(CommunicationModule):
             return {
                 "master": jax.tree.map(jnp.array, params),
                 "outer_opt": self.outer_tx.init(params),
+                **self.link.init(tree_num_params(params)),
             }
         if self._ctx is None:
             raise StrategyLifecycleError(
@@ -159,10 +185,43 @@ class DiLoCoCommunicator(CommunicationModule):
             return (new_params,
                     {"master": master, "outer_opt": outer_opt}, comm)
 
+        def outer_compressed(params, mstate):
+            """The codec path: each node compresses its OUTER DELTA
+            (params − master) through the link — with error feedback,
+            the dropped/rounded mass re-enters the next round's delta —
+            and the round average is reassembled as
+            ``master + mean(deltâ)``. The master is replicated and the
+            pmean is a collective, so the reconstruction (and hence the
+            outer Nesterov step) stays bit-identical on every node; only
+            each node's rounding noise is node-specific (per-node
+            ``link_key``, folded from the node index)."""
+            flat_p, unravel = ravel_pytree(params)
+            flat_m, _ = ravel_pytree(mstate["master"])
+            delta = flat_p.astype(jnp.float32) - flat_m.astype(jnp.float32)
+            key = self.link.key(step, hop=0, node=ctx.node_index())
+            lstate = ({"ef_residual": mstate["ef_residual"]}
+                      if self.link.error_feedback else {})
+            delta_hat, lstate = self.link.send(delta, lstate, key)
+            avg_flat = flat_m.astype(jnp.float32) + ctx.pmean(delta_hat)
+            avg = jax.tree.map(lambda a, p: a.astype(p.dtype),
+                               unravel(avg_flat), params)
+            master = mstate["master"]
+            pseudo = jax.tree.map(jnp.subtract, master, avg)
+            updates, outer_opt = self.outer_tx.update(
+                pseudo, mstate["outer_opt"], master)
+            master = optax.apply_updates(master, updates)
+            comm = 2.0 * (k - 1) / k * self.link.wire_bytes(delta.size)
+            return (master,
+                    {"master": master, "outer_opt": outer_opt, **lstate},
+                    jnp.asarray(comm, jnp.float32))
+
         def skip(params, mstate):
             return params, mstate, jnp.zeros(())
 
-        outer = outer_sharded if self.shard_outer else outer_replicated
+        if self.link.compressed:
+            outer = outer_compressed
+        else:
+            outer = outer_sharded if self.shard_outer else outer_replicated
         do = jnp.logical_and(step % self.H == 0, step > 0)
         params, mstate, comm = jax.lax.cond(do, outer, skip, params, mstate)
         if self.shard_outer:
@@ -174,6 +233,15 @@ class DiLoCoCommunicator(CommunicationModule):
         if num_nodes <= 1 or not (step % self.H == 0 and step > 0):
             return []
         psize = float(tree_bytes(params))
+        if self.link.compressed:
+            # compressed round average of the outer delta: declared at
+            # the codec's honest wire bytes; the emulation pmeans the
+            # reconstructed dense f32 delta, bounded by emulated_bytes
+            n = tree_num_params(params)
+            return [CollectiveEvent(
+                "all_reduce", self.link.wire_bytes(n), num_nodes,
+                label="outer_delta_compressed",
+                emulated_bytes=4.0 * n)]
         if self.shard_outer:
             # round average + the extra all_gather that reassembles the
             # sharded master: 3(K−1)/K·|θ| total (participation<1 is
@@ -199,6 +267,8 @@ class DiLoCoCommunicator(CommunicationModule):
             cfg["shard_outer"] = True
         if self.participation < 1.0:
             cfg["participation"] = self.participation
+        if self.link.compressed:
+            cfg.update(self.link.config())
         return cfg
 
 
@@ -217,13 +287,19 @@ class DiLoCoStrategy(CommunicateOptimizeStrategy):
         lr_scheduler_kwargs=None,
         shard_outer: bool = False,
         participation: float = 1.0,
+        codec: Union[str, Codec, None] = None,
+        error_feedback: Optional[bool] = None,
+        **codec_kwargs,
     ):
         self.H = int(H)
         super().__init__(
             communication_modules=[
                 DiLoCoCommunicator(H=H, outer_optim_spec=outer_optim_spec,
                                    shard_outer=shard_outer,
-                                   participation=participation)
+                                   participation=participation,
+                                   codec=codec,
+                                   error_feedback=error_feedback,
+                                   **codec_kwargs)
             ],
             inner_optim=ensure_optim_spec(optim_spec, OptimSpec("adamw")),
             max_norm=max_norm,

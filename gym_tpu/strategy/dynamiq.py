@@ -53,7 +53,7 @@ from jax.flatten_util import ravel_pytree
 from .base import (CollectiveEvent, PyTree, Strategy,
                    StrategyLifecycleError, comm_metric, require_finalized,
                    tree_num_params)
-from .compress import Codec, hop_keys, make_codec
+from .compress import Codec, CompressedLink, hop_keys, make_codec
 from .optim import OptimSpec, ensure_optim_spec
 
 
@@ -74,6 +74,17 @@ class DynamiQStrategy(Strategy):
         self.optim_spec = ensure_optim_spec(optim_spec, OptimSpec("adamw"))
         self.codec = make_codec(codec, **codec_kwargs)
         self.seed = int(seed)
+        # the shared wire path (ISSUE 12 dedup): both hops encode through
+        # CompressedLink. EF only when the codec is biased (top-k) — the
+        # link's EF default is for OUTER deltas; DynamiQ's per-hop
+        # residual layout ("residual"/"residual2", hop-2 sized n/K)
+        # predates the link and stays, so the residuals are passed to
+        # `encode` explicitly rather than carried in link state. Keys
+        # stay the original `hop_keys(seed, step)` schedule — the dedup
+        # is a refactor, not a behavior change (pinned by the DynamiQ
+        # trace/parity tests).
+        self._link = CompressedLink(self.codec, seed=self.seed,
+                                    error_feedback=self.codec.error_feedback)
         self.tx: optax.GradientTransformation | None = None
 
     def _build(self):
@@ -122,12 +133,12 @@ class DynamiQStrategy(Strategy):
             shard = -(-n // k)
             pad = k * shard - n
             k_hop1, k_hop2 = hop_keys(self.seed, step)
-            send = flat_g.astype(jnp.float32)
-            if self.codec.error_feedback:
-                send = send + state["residual"]
-            g_hat = self.codec.roundtrip(send, k_hop1)
-            if self.codec.error_feedback:
-                new_state["residual"] = send - g_hat
+            ef = self.codec.error_feedback
+            g_hat, res1 = self._link.encode(
+                flat_g.astype(jnp.float32),
+                state["residual"] if ef else None, k_hop1)
+            if ef:
+                new_state["residual"] = res1
             g_pad = jnp.pad(g_hat, (0, pad))
 
             if len(ctx.axes) == 1:
@@ -143,12 +154,10 @@ class DynamiQStrategy(Strategy):
             # hop 2: compress the reduced chunk, gather everyone's
             # (double EF: this node owns the same chunk index every
             # step, so the residual stays aligned)
-            send2 = chunk
-            if self.codec.error_feedback:
-                send2 = send2 + state["residual2"]
-            chunk_hat = self.codec.roundtrip(send2, k_hop2)
-            if self.codec.error_feedback:
-                new_state["residual2"] = send2 - chunk_hat
+            chunk_hat, res2 = self._link.encode(
+                chunk, state["residual2"] if ef else None, k_hop2)
+            if ef:
+                new_state["residual2"] = res2
             gathered = ctx.all_gather(chunk_hat)    # [K, shard]
             mean_flat = gathered.reshape(-1)[:n]
             mean_tree = unravel(mean_flat)

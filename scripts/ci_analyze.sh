@@ -5,8 +5,9 @@
 # crafted programs and snippets; the CLI run proves the shipped tree is
 # clean end to end: jaxpr audit (zero unconsumed donations, zero
 # hot-path host callbacks, zero f64 upcasts for trainer + engine
-# programs), static comm reconciliation for all 12 strategy configs
-# (incl. the ISSUE 10 noloco/dynamiq low-comm family), and the
+# programs), static comm reconciliation for all 16 strategy configs
+# (incl. the ISSUE 10 noloco/dynamiq low-comm family and the ISSUE 12
+# compressed outer loops), and the
 # host-concurrency lint with zero unsuppressed violations. Pure host
 # work — nothing is compiled or executed on a device; <90 s on the
 # 2-core container.
@@ -40,10 +41,13 @@ sections = report["sections"]
 assert set(sections) == {"lint", "trace", "audit"}
 for name, summ in sections["trace"]["strategies"].items():
     assert summ["ok"], (name, summ)
-assert len(sections["trace"]["strategies"]) >= 12
+# ISSUE 12 bump: + the compressed outer loops (diloco int8/topk,
+# noloco int4, decoupled-momentum outer)
+assert len(sections["trace"]["strategies"]) >= 16
 # ISSUE 11 bump: + the quantized serving family (int8 weights + int8
-# paged KV — paged prefill x2, CoW, paged decode, spec decode)
-assert len(sections["audit"]["programs"]) >= 26
+# paged KV — paged prefill x2, CoW, paged decode, spec decode);
+# ISSUE 12: + the 4 compressed-outer-loop trainer steps
+assert len(sections["audit"]["programs"]) >= 30
 # ISSUE 9 gate: the auditor's serve key set and the device-program
 # registry's key set are THE SAME set — enumeration and acquisition
 # cannot drift apart

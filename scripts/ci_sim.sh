@@ -25,12 +25,14 @@ echo SIM_DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' \
 # CLI smoke sweep: fresh out dir (a stale one would resume-skip every
 # cell and test nothing), 4 strategies × 2 topologies, tiny steps —
 # including the ISSUE 10 low-communication cells (noloco gossip,
-# dynamiq-int8 compressed all-reduce).
+# dynamiq-int8 compressed all-reduce) and the ISSUE 12 codec axis
+# (dense + int4 cells for the CompressedLink family).
 SWEEP_OUT=${GYM_TPU_CI_SWEEP_OUT:-/tmp/gym_tpu_ci_sweep}
 rm -rf "$SWEEP_OUT"
 timeout -k 10 420 env JAX_PLATFORMS=cpu python -m gym_tpu.sim.sweep \
     --preset wan,datacenter \
     --strategies diloco,simple_reduce,noloco,dynamiq_int8 \
+    --codecs dense,int4 \
     --nodes 2 --steps 8 --batch_size 4 --block_size 32 \
     --n_layer 1 --n_embd 32 --out "$SWEEP_OUT"
 rc=$?
@@ -40,13 +42,24 @@ grep -q "Headline: DiLoCo" "$SWEEP_OUT/report.md" || {
 grep -q "RECONCILIATION FAILURES" "$SWEEP_OUT/report.md" && {
     echo "ci_sim: trace/cum_comm_bytes reconciliation failed"; exit 1; }
 # the low-comm cells ran, reconciled, and reached the frontier artifact
-for cell in noloco_H10_n2_wan dynamiq_int8_n2_wan; do
+for cell in noloco_H10_n2_wan noloco_H10_int4_n2_wan \
+            diloco_H10_int4_n2_wan dynamiq_int8_n2_wan; do
     grep -q "\"cell\": \"$cell\"" "$SWEEP_OUT/results.json" || {
         echo "ci_sim: sweep missing cell $cell"; exit 1; }
 done
 grep -q "^wan,2,noloco" "$SWEEP_OUT/frontier.csv" || {
     echo "ci_sim: frontier.csv missing the noloco verdict row"; exit 1; }
+grep -q "noloco H=10 int4" "$SWEEP_OUT/frontier.csv" || {
+    echo "ci_sim: frontier.csv missing the compressed-gossip row"; exit 1; }
 grep -q "^wan,2,dynamiq int8" "$SWEEP_OUT/frontier.csv" || {
     echo "ci_sim: frontier.csv missing the dynamiq verdict row"; exit 1; }
+
+# ISSUE 12 frontier regression gate: re-price the federated family via
+# the cost-model fast path and fail if the best compressed-gossip
+# speedup dropped below the recorded baseline (committed beside the
+# acceptance sweep's frontier.csv under logs/frontier/).
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m gym_tpu.sim.frontier_gate \
+    --baseline logs/frontier/frontier_baseline.json || {
+    echo "ci_sim: frontier regression gate failed"; exit 1; }
 echo "ci_sim: OK (report at $SWEEP_OUT/report.md)"
 exit 0

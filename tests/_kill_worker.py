@@ -26,6 +26,12 @@ def main() -> None:
                          "at the dispatch boundary, so a kill at boundary "
                          "N deterministically finds earlier saves durable")
     ap.add_argument("--no-prefetch", action="store_true")
+    ap.add_argument("--strategy", default="simple",
+                    choices=["simple", "diloco_int4"],
+                    help="simple: SimpleReduce SGD (the original harness "
+                         "workload); diloco_int4: compressed DiLoCo whose "
+                         "error-feedback residual must round-trip through "
+                         "checkpoint save/restore (ISSUE 12)")
     ap.add_argument("--result", default="")
     args = ap.parse_args()
 
@@ -36,7 +42,8 @@ def main() -> None:
 
     from gym_tpu import Trainer
     from gym_tpu.data import ArrayDataset
-    from gym_tpu.strategy import OptimSpec, SimpleReduceStrategy
+    from gym_tpu.strategy import (DiLoCoStrategy, OptimSpec,
+                                  SimpleReduceStrategy)
     from gym_tpu.utils.compile_cache import enable_compilation_cache
 
     cache = os.environ.get("GYM_TPU_TEST_COMPILE_CACHE")
@@ -60,8 +67,17 @@ def main() -> None:
     for i, y in enumerate(labels):
         x[i, y % 8, :] += 1.5
 
+    if args.strategy == "diloco_int4":
+        # H=2 < ckpt interval 3 ⇒ every checkpoint lands mid-cycle with
+        # a NONZERO error-feedback residual in the strategy state — the
+        # resumed trajectory is only bit-identical if it round-trips
+        strategy = DiLoCoStrategy(optim_spec=OptimSpec("sgd", lr=0.05),
+                                  H=2, codec="int4")
+    else:
+        strategy = SimpleReduceStrategy(OptimSpec("sgd", lr=0.05))
+
     res = Trainer(Tiny(), ArrayDataset(x, labels)).fit(
-        strategy=SimpleReduceStrategy(OptimSpec("sgd", lr=0.05)),
+        strategy=strategy,
         num_nodes=2, max_steps=args.max_steps, batch_size=16,
         minibatch_size=8, val_interval=0, show_progress=False, seed=3,
         checkpoint_interval=args.ckpt_interval, save_dir=args.save_dir,

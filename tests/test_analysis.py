@@ -131,7 +131,8 @@ def test_walker_counts_scan_multiplicity_and_control_plane():
 @pytest.mark.parametrize("name", [
     "simple_reduce", "zero_reduce", "zero_reduce_vnode", "diloco",
     "fedavg", "sparta", "demo", "sparta_diloco", "noloco", "dynamiq",
-    "dynamiq_vnode", "dynamiq_topk"])
+    "dynamiq_vnode", "dynamiq_topk", "diloco_int8", "diloco_topk",
+    "noloco_int4", "demo_outer"])
 def test_static_reconciliation_all_strategies(name):
     """jaxpr-extracted collective inventory == declared comm_events,
     op-for-op and byte-for-byte (folded comm_bytes metric), over a full
@@ -141,9 +142,20 @@ def test_static_reconciliation_all_strategies(name):
     # the cycle actually exercises both silent and communicating steps
     # for the gated strategies
     txs = [s.declared_tx for s in res.steps]
-    if name in ("diloco", "fedavg", "noloco"):
+    if name in ("diloco", "fedavg", "noloco", "diloco_int8",
+                "diloco_topk", "noloco_int4", "demo_outer"):
         # the cycle exercises both silent and communicating steps
         assert any(t == 0 for t in txs) and any(t > 0 for t in txs)
+    if name in ("diloco_int8", "diloco_topk", "noloco_int4",
+                "demo_outer"):
+        # the compressed outer rounds talk at well under the dense
+        # round's cost (int8 ≈ 1/4, int4 ≈ 1/8, top-k 5% ≈ 1/12 of the
+        # respective dense convention)
+        psize = tree_bytes(DEFAULT_TEMPLATE)
+        dense_round = (psize if name.startswith("noloco")
+                       else 2 * 3 / 4 * psize)
+        assert all(t < 0.5 * dense_round for t in txs if t > 0), \
+            (txs, dense_round)
     if name == "sparta_diloco":
         # gossip every step, outer round only at H: two distinct levels
         assert len(set(round(t) for t in txs)) >= 2
@@ -291,6 +303,57 @@ def test_falsified_low_comm_traces_are_caught():
             (NotAPermutation, "not a permutation"),
             (WrongCompressedBytes, "static comm_bytes"),
             (UndeclaredResidualGather, "dense-emulation bound")):
+        res = check_strategy(cls(), num_nodes=4)
+        assert not res.ok, cls.__name__
+        assert any(frag in e for s in res.failures() for e in s.errors), \
+            (cls.__name__, [s.errors for s in res.failures()])
+
+
+def test_falsified_compressed_outer_loop_traces_are_caught():
+    """The ISSUE 12 falsification fixtures — the codec axis must not
+    weaken the gates:
+
+    - WrongWireBytes: a compressed DiLoCo declaring half its link's
+      honest wire bytes (codec bytes are far below the dense emulation
+      anyway, so only the folded comm_bytes metric can refute it).
+    - UndeclaredResidualExchange: a compressed NoLoCo that gossips its
+      error-feedback residual alongside the params without declaring it
+      — wire accounting still matches, but the gathered dense payload
+      exceeds the declared ``emulated_bytes`` bound.
+    """
+    from gym_tpu.strategy import DiLoCoStrategy, NoLoCoStrategy
+    from gym_tpu.strategy.noloco import NoLoCoCommunicator
+
+    class WrongWireBytes(DiLoCoStrategy):
+        def __init__(self):
+            super().__init__(H=2, codec="int4")
+
+        def comm_events(self, step, params, num_nodes):
+            return [
+                CollectiveEvent(e.op, e.bytes / 2, e.group, label=e.label,
+                                emulated_bytes=e.emulated_bytes)
+                for e in super().comm_events(step, params, num_nodes)]
+
+    class _LeakyGossip(NoLoCoCommunicator):
+        def communicate(self, params, mstate, step, ctx):
+            params, mstate, comm = super().communicate(
+                params, mstate, step, ctx)
+            # smuggle the residual into an extra gather; fold a value
+            # through so it isn't dead code, keep the metric unchanged
+            leak = ctx.all_gather(mstate["ef_residual"])
+            mstate = dict(mstate,
+                          ef_residual=mstate["ef_residual"]
+                          + 0.0 * leak.sum())
+            return params, mstate, comm
+
+    class UndeclaredResidualExchange(NoLoCoStrategy):
+        def __init__(self):
+            super().__init__(H=2, codec="int4")
+            self.communication_modules[0].__class__ = _LeakyGossip
+
+    for cls, frag in (
+            (WrongWireBytes, "static comm_bytes"),
+            (UndeclaredResidualExchange, "dense-emulation bound")):
         res = check_strategy(cls(), num_nodes=4)
         assert not res.ok, cls.__name__
         assert any(frag in e for s in res.failures() for e in s.errors), \

@@ -7,7 +7,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from gym_tpu.strategy.compress import (QuantizeCodec, TopKCodec, hop_keys,
+from gym_tpu.strategy.compress import (CompressedLink, QuantizeCodec,
+                                       TopKCodec, hop_keys, link_key,
                                        make_codec)
 
 
@@ -182,6 +183,104 @@ def test_hop_keys_shared_schedule_host_vs_traced():
     assert not np.array_equal(np.asarray(host[0]), np.asarray(host[1]))
     assert not np.array_equal(np.asarray(hop_keys(7, 4)),
                               np.asarray(host))
+
+
+# -- CompressedLink (ISSUE 12) ---------------------------------------------
+
+
+def test_link_dense_is_identity_with_dense_accounting():
+    """codec=None / "dense" is the identity link: payloads pass through
+    untouched, no residual state, wire bytes are plain f32 — which is
+    what makes "dense" a cell on the same codec axis as int8/topk."""
+    for spec in (None, "dense"):
+        link = CompressedLink(spec)
+        assert not link.compressed and not link.error_feedback
+        assert link.init(100) == {}
+        x = _vec(64)
+        out, res = link.encode(x, None, link.key(0))
+        assert out is x and res is None
+        assert link.wire_bytes(100) == 400.0
+        assert link.config() == {"codec": "dense"}
+    with pytest.raises(ValueError, match="dense"):
+        CompressedLink(None, tile=64)
+
+
+def test_link_error_feedback_default_and_ablation_knob():
+    """EF defaults ON for every lossy codec (int4 outer deltas need it —
+    the fit-level ablation is in test_sim), OFF for dense; the explicit
+    error_feedback=False ablation knob disables it."""
+    assert CompressedLink("int8").error_feedback
+    assert CompressedLink("int4").error_feedback
+    assert CompressedLink("topk", frac=0.1).error_feedback
+    assert not CompressedLink("int4",
+                              error_feedback=False).error_feedback
+    assert not CompressedLink(None, error_feedback=True).error_feedback
+    link = CompressedLink("int4")
+    st = link.init(33)
+    assert st["ef_residual"].shape == (33,)
+    assert st["ef_residual"].dtype == jnp.float32
+
+
+def test_link_encode_runs_the_ef_recursion_exactly():
+    """encode(x, r) must deliver roundtrip(x + r) and return residual
+    (x + r) − delivered — the EF-SGD recursion, bit-for-bit."""
+    link = CompressedLink("topk", frac=0.2)
+    x, r = _vec(50, seed=1), _vec(50, seed=2) * 0.1
+    key = link.key(3, 0)
+    out, new_r = link.encode(x, r, key)
+    ref = link.codec.roundtrip(x + r, key)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    np.testing.assert_array_equal(np.asarray(new_r),
+                                  np.asarray((x + r) - ref))
+    # dict-state form agrees
+    out2, lstate = link.send(x, {"ef_residual": r}, key)
+    np.testing.assert_array_equal(np.asarray(out2), np.asarray(out))
+    np.testing.assert_array_equal(np.asarray(lstate["ef_residual"]),
+                                  np.asarray(new_r))
+
+
+def test_link_key_no_reuse_across_step_hop_node():
+    """The ISSUE 12 key-handling fix: keys derive from the strategy's
+    base seed per (step, hop, node) — no reuse between hops of one step
+    or between gossip partners within a step — and the traced (in-jit)
+    derivation equals the host one."""
+    base = link_key(7, 3, 0, 0)
+    for other in (link_key(7, 4, 0, 0),     # step
+                  link_key(7, 3, 1, 0),     # hop
+                  link_key(7, 3, 0, 1),     # node (gossip partner)
+                  link_key(8, 3, 0, 0)):    # seed
+        assert not np.array_equal(np.asarray(base), np.asarray(other))
+    traced = jax.jit(lambda s, n: link_key(7, s, 0, n))(
+        jnp.asarray(3, jnp.int32), jnp.asarray(0, jnp.int32))
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(traced))
+    # CompressedLink.key is the same derivation with the link's own seed
+    link = CompressedLink("int8", seed=7)
+    np.testing.assert_array_equal(np.asarray(link.key(3, 0, 0)),
+                                  np.asarray(base))
+
+
+def test_link_same_seed_bit_identical_across_runs():
+    """Determinism (ISSUE 12 satellite): two independent runs of the
+    same compressed exchange under the same seed are bit-identical —
+    keys are pure functions of (seed, step, hop, node), never stateful
+    draws."""
+    def run():
+        link = CompressedLink("int4", seed=11, tile=32)
+        st = link.init(200)
+        outs = []
+        x = _vec(200, seed=4)
+        for step in range(3):
+            for node in range(2):
+                out, st2 = link.send(x, st, link.key(step, 0, node))
+                outs.append(np.asarray(out))
+            st = st2
+        return outs
+
+    a, b = run(), run()
+    for xa, xb in zip(a, b):
+        np.testing.assert_array_equal(xa, xb)
+    # and the two partners of one step drew DIFFERENT rounding noise
+    assert not np.array_equal(a[0], a[1])
 
 
 def test_quantized_codec_jit_clean():

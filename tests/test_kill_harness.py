@@ -59,7 +59,7 @@ def scratch(tmp_path_factory):
 
 
 def _run_worker(save_dir, log_dir, *, faults="", result=None, timeout=240,
-                sync_ckpt=False):
+                sync_ckpt=False, strategy=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     # 2 devices, not the 16 conftest forces in-process: each subprocess
@@ -76,6 +76,8 @@ def _run_worker(save_dir, log_dir, *, faults="", result=None, timeout=240,
         cmd += ["--result", str(result)]
     if sync_ckpt:
         cmd += ["--sync-ckpt"]
+    if strategy:
+        cmd += ["--strategy", strategy]
     return subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
                           text=True, timeout=timeout)
 
@@ -136,6 +138,45 @@ def test_kill9_at_dispatch_boundary_resumes_bit_identical(scratch, baseline):
                                   "checkpoint.device_get"])
 def test_kill9_at_site_resumes_bit_identical(scratch, baseline, site):
     _kill_resume_roundtrip(scratch, baseline, site)
+
+
+def test_kill9_compressed_diloco_residual_roundtrips_bit_identical(
+        scratch, baseline):
+    """ISSUE 12 satellite: the error-feedback residual is TRAINING STATE
+    and must survive ``fit(resume=...)``. The worker runs compressed
+    DiLoCo (int4, H=2) with checkpoints every 3 steps, so every
+    checkpoint holds a mid-cycle NONZERO residual; kill -9 at a dispatch
+    boundary past a durable save, resume fault-free, and the stitched
+    ``train.csv`` must be byte-identical to the uninterrupted run — a
+    residual that failed to restore (or restored zeroed) would change
+    every post-resume outer round's delivered delta and the losses with
+    it. (``baseline`` is only depended on for the shared compile
+    cache.)"""
+    save = scratch / "ef_ckpt"
+    log = scratch / "ef_logs"
+    result = scratch / "ef.json"
+
+    # uninterrupted oracle for THIS strategy
+    p = _run_worker(scratch / "ef_base_ckpt", scratch / "ef_base_logs",
+                    result=scratch / "ef_base.json", sync_ckpt=True,
+                    strategy="diloco_int4")
+    assert p.returncode == 0, p.stderr[-4000:]
+    oracle = _train_csv(scratch / "ef_base_logs")
+
+    p = _run_worker(save, log, faults="dispatch.boundary:kill@8",
+                    sync_ckpt=True, strategy="diloco_int4")
+    assert p.returncode == -signal.SIGKILL, p.stderr[-4000:]
+
+    p = _run_worker(save, log, result=result, sync_ckpt=True,
+                    strategy="diloco_int4")
+    assert p.returncode == 0, p.stderr[-4000:]
+    res = json.loads(open(result).read())
+    assert res["steps"] == MAX_STEPS
+    first_logged = res["losses"][0][0]
+    assert first_logged > 0 and first_logged % CKPT_INTERVAL == 0
+    assert _train_csv(log) == oracle, (
+        "compressed DiLoCo crash+resume is not bit-identical — the "
+        "error-feedback residual did not round-trip")
 
 
 def test_sigterm_drill_emergency_checkpoint_and_clean_exit(scratch,

@@ -16,6 +16,18 @@ from gym_tpu.strategy import (DiLoCoStrategy, FedAvgStrategy, OptimSpec,
                               SPARTAStrategy, ZeroReduceStrategy)
 
 
+def _noloco_int8(**kw):
+    from gym_tpu.strategy import NoLoCoStrategy
+    return NoLoCoStrategy(optim_spec=OptimSpec("sgd", lr=0.1),
+                          codec="int8", **kw)
+
+
+def _demo_outer(**kw):
+    from gym_tpu.strategy import DecoupledMomentumStrategy
+    return DecoupledMomentumStrategy(optim_spec=OptimSpec("sgd", lr=0.1),
+                                     frac=0.2, **kw)
+
+
 def make_harness(strategy, num_nodes, params_np, max_steps=100,
                  devices=None):
     """Compile per-step strategy application over the node mesh.
@@ -54,8 +66,12 @@ def make_harness(strategy, num_nodes, params_np, max_steps=100,
                            p_sparta=0.5),
     lambda: SPARTADiLoCoStrategy(optim_spec=OptimSpec("sgd", lr=0.1),
                                  p_sparta=0.5, H=2),
+    lambda: DiLoCoStrategy(optim_spec=OptimSpec("sgd", lr=0.1), H=2,
+                           codec="int4"),
+    lambda: _noloco_int8(H=2),
+    lambda: _demo_outer(H=2),
 ], ids=["simple_reduce", "zero_reduce", "diloco", "fedavg", "sparta",
-        "sparta_diloco"])
+        "sparta_diloco", "diloco_int4", "noloco_int8", "demo_outer"])
 def test_comm_bytes_metric_normalized(strategy_fn):
     """Every strategy's comm_bytes metric flows through one helper
     (strategy.base.comm_metric): float32, scalar per node — the
@@ -541,3 +557,230 @@ def test_dynamiq_error_feedback_conserves_dropped_mass_exactly():
     # all nodes decompress the same gathered payloads → identical params
     for k in range(1, K):
         np.testing.assert_array_equal(final[k], final[0])
+
+
+# -- compressed outer loops (ISSUE 12: CompressedLink × strategy) ----------
+
+
+def test_compressed_diloco_outer_round_within_bins_of_dense():
+    """One int8 outer round must land within a few quantization bins of
+    the dense DiLoCo round on the same grads (the delta is what's
+    compressed, so the bin is amax(delta)/127 per tile), and the
+    replicas stay bit-identical (the pmean reconstruction is a
+    collective)."""
+    K, H = 4, 2
+    w0 = {"w": np.full((K, 64), 10.0, np.float32)}
+    g = np.repeat(np.linspace(-3, 1, K, dtype=np.float32)[:, None], 64, 1)
+
+    def run(**kw):
+        strat = DiLoCoStrategy(optim_spec=OptimSpec("sgd", lr=1.0), H=H,
+                               **kw)
+        rt, step_fn, params, state = make_harness(strat, K, dict(w0))
+        for t in range(H + 1):
+            params, state, m = step_fn(params, state, {"w": g}, t)
+        return jax.device_get(params)["w"], jax.device_get(state), m
+
+    p_dense, _, _ = run()
+    p_q, st_q, m = run(codec="int8", tile=64)
+    # per-node delta after 3 inner steps is 3·g_k; bins per node ≤
+    # amax(3·g)/127; the averaged reconstruction error is within a few
+    # bins through the outer Nesterov step (factor 1.9 = 1+momentum)
+    bin_size = 3 * np.abs(g).max() / 127
+    assert np.abs(p_q - p_dense).max() <= 3 * 1.9 * bin_size
+    for k in range(1, K):
+        np.testing.assert_array_equal(p_q[k], p_q[0])
+    # the residual is genuine training state on every node
+    res = st_q["modules"][0]["ef_residual"]
+    assert res.shape == (K, 64) and np.any(res != 0)
+    # metric = the declared compressed wire cost
+    from gym_tpu.strategy import CompressedLink
+    wire = CompressedLink("int8", tile=64).wire_bytes(64)
+    assert np.all(m["comm_bytes"] == pytest.approx(2 * 3 / 4 * wire))
+
+
+def test_compressed_diloco_error_feedback_conserves_dropped_mass():
+    """The EF conservation law at the strategy level, deterministic:
+    with a top-k link and a pass-through outer step (SGD lr=1, no
+    momentum, so ``master <- master + mean(delta_hat)`` is directly
+    observable), NOTHING is ever lost: after T steps
+    ``master == total_true_delta - mean_i(residual_i)`` exactly. The
+    ablated link (error_feedback=False) permanently drops every
+    never-selected coordinate; its master provably violates the
+    conservation that the residual restores."""
+    K, H, n = 2, 1, 50
+    w0 = {"w": np.zeros((K, n), np.float32)}
+    # one tiny coordinate (index 0), the rest large: frac=0.1 keeps 5
+    g_row = np.r_[0.01, np.linspace(1, 2, n - 1)].astype(np.float32)
+    g = {"w": np.repeat(g_row[None], K, 0)}
+    T = 12   # steps; rounds fire at t=1..11 (H=1, step>0 gate)
+
+    def run(error_feedback):
+        strat = DiLoCoStrategy(
+            optim_spec=OptimSpec("sgd", lr=1.0),
+            outer_optim_spec=OptimSpec("sgd", lr=1.0, momentum=0.0,
+                                       nesterov=False),
+            H=H, codec="topk", frac=0.1, error_feedback=error_feedback)
+        rt, step_fn, params, state = make_harness(strat, K, dict(w0))
+        for t in range(T):
+            params, state, _ = step_fn(params, state, g, t)
+        return (jax.device_get(params)["w"],
+                jax.device_get(state)["modules"][0])
+
+    p_ef, ms = run(True)
+    p_ablate, ms_ablate = run(False)
+    # total true delta fed into the link per node: 2 inner steps before
+    # the first round, then 1 per round -> -T*g in total
+    total = -T * g_row
+    # conservation: master == total - mean_i(residual_i), exactly
+    undelivered = ms["ef_residual"].mean(axis=0)
+    np.testing.assert_allclose(p_ef[0], total - undelivered,
+                               rtol=1e-4, atol=1e-5)
+    # the ablated link has no residual, and the dropped coordinate's
+    # mass (~ -0.12 here) is gone for good: nothing accounts for it
+    assert "ef_residual" not in ms_ablate
+    assert p_ablate[0][0] == 0.0
+    assert abs(p_ablate[0][0] - total[0]) > 0.1
+    # the EF residual is exactly where coordinate 0's mass lives
+    assert abs(undelivered[0] - total[0]) < 1e-5
+    # both runs deliver the large coordinates
+    assert p_ef[0][-1] < -10 and p_ablate[0][-1] < -10
+
+
+def test_compressed_noloco_gossip_within_bins_and_deterministic():
+    """Compressed gossip: avg_i = (p_i + p̂_σ(i))/2 with p̂ the partner's
+    int8 reconstruction — within one bin of the dense gossip — and the
+    whole exchange is bit-reproducible across runs (link keys are pure
+    functions of (seed, step, node)), with the two partners of a pair
+    drawing DIFFERENT rounding noise."""
+    from gym_tpu.strategy import NoLoCoStrategy
+
+    K, H, n = 4, 2, 64
+    rng = np.random.default_rng(21)
+    w0 = {"w": rng.normal(size=(K, n)).astype(np.float32)}
+    zeros = {"w": np.zeros((K, n), np.float32)}
+
+    def run(codec=None, **kw):
+        strat = NoLoCoStrategy(
+            optim_spec=OptimSpec("sgd", lr=0.0),
+            outer_optim_spec=OptimSpec("sgd", lr=1.0, momentum=0.0,
+                                       nesterov=False),
+            H=H, codec=codec, **kw)
+        rt, step_fn, params, state = make_harness(strat, K, dict(w0))
+        params, state, m = step_fn(params, state, zeros, H)
+        return jax.device_get(params)["w"], m, strat
+
+    dense, _, _ = run()
+    q1, m, strat = run(codec="int8", tile=n)
+    q2, _, _ = run(codec="int8", tile=n)
+    np.testing.assert_array_equal(q1, q2)          # bit-reproducible
+    bin_size = np.abs(w0["w"]).max() / 127
+    # only the partner half is quantized → error ≤ bin/2 per element
+    assert np.abs(q1 - dense).max() <= bin_size
+    sigma = strat.partner_permutation(H, K)
+    # partner i's contribution was quantized with node σ(i)'s key; own
+    # half is lossless: avg − p_i/2 differs from p_σ(i)/2 by the
+    # partner's rounding noise, which differs BETWEEN partners
+    noise = [q1[i] - 0.5 * (w0["w"][i] + w0["w"][sigma[i]])
+             for i in range(K)]
+    assert any(not np.array_equal(noise[0], nz) for nz in noise[1:])
+    # p2p accounting: the codec's wire bytes, not |θ|
+    from gym_tpu.strategy import CompressedLink
+    wire = CompressedLink("int8", tile=n).wire_bytes(n)
+    assert np.all(m["comm_bytes"] == wire)
+    assert wire < 4.0 * n
+
+
+def test_noloco_partner_permutation_odd_and_non_power_of_two():
+    """ISSUE 12 satellite: the shared-PRNG partner draw at K = 3, 5, 6.
+    A perfect pairing (involution) cannot exist for odd K; the module's
+    documented design is a random K-CYCLE — always fixed-point-free, so
+    every node still sends exactly once and receives exactly once — and
+    the byte accounting (|θ| per node, pairs a permutation) must hold at
+    every K, matching the jitted draw."""
+    from gym_tpu.strategy import NoLoCoStrategy
+
+    PARAMS = {"w": jax.ShapeDtypeStruct((40,), np.float32)}
+    s = NoLoCoStrategy(H=2)
+    for K in (3, 5, 6):
+        for step in (2, 4, 8):
+            sigma = s.partner_permutation(step, K)
+            assert sorted(sigma) == list(range(K)), (K, step, sigma)
+            assert np.all(sigma != np.arange(K)), (K, step, sigma)
+            # the host twin IS the jitted draw
+            jitted = np.asarray(jax.jit(
+                lambda st, k=K: s._perm_jax(st, k)
+            )(jnp.asarray(step, jnp.int32)))
+            np.testing.assert_array_equal(sigma, jitted)
+            evs = s.comm_events(step, PARAMS, K)
+            assert len(evs) == 1 and evs[0].op == "p2p"
+            # every node transmits exactly |θ| = 160 B
+            assert evs[0].per_node_tx() == 160.0
+            srcs = sorted(i for i, _ in evs[0].pairs)
+            dsts = sorted(j for _, j in evs[0].pairs)
+            assert srcs == dsts == list(range(K))
+    # and the jitted step at an ODD node count reports the same metric
+    K = 3
+    strat = NoLoCoStrategy(optim_spec=OptimSpec("sgd", lr=0.0), H=2)
+    w0 = {"w": np.random.default_rng(0).normal(
+        size=(K, 40)).astype(np.float32)}
+    rt, step_fn, params, state = make_harness(strat, K, w0)
+    params, state, m = step_fn(params, state,
+                               {"w": np.zeros((K, 40), np.float32)}, 2)
+    assert np.all(m["comm_bytes"] == 160.0)
+
+
+def test_demo_outer_dense_limit_is_parameter_averaging():
+    """Decoupled momentum sanity oracle: replicas start identical (the
+    framework invariant) and drift via per-node gradients; with the
+    dense identity link, beta=0 and outer_lr=1, one sync is EXACTLY
+    parameter averaging (master <- master + mean(drift_i) =
+    mean(params_i)) -- and with a top-k link the masters stay
+    node-identical while the momentum buffers keep the undelivered
+    remainder."""
+    from gym_tpu.strategy import DecoupledMomentumStrategy
+
+    K, H, n = 4, 2, 30
+    rng = np.random.default_rng(23)
+    w0 = {"w": np.repeat(rng.normal(size=(1, n)).astype(np.float32),
+                         K, 0)}
+    # per-node drift: inner SGD lr=1 moves node k by -g_k per step
+    g = {"w": rng.normal(size=(K, n)).astype(np.float32)}
+
+    def run(**kw):
+        strat = DecoupledMomentumStrategy(
+            optim_spec=OptimSpec("sgd", lr=1.0), H=H, **kw)
+        rt, step_fn, params, state = make_harness(strat, K, dict(w0))
+        for t in range(H + 1):
+            params, state, m = step_fn(params, state, g, t)
+        return (jax.device_get(params)["w"], jax.device_get(state), m)
+
+    p, st, m = run(codec=None, outer_lr=1.0, outer_momentum=0.0)
+    # 3 inner steps before the sync at t=2: params_k = w0 - 3*g_k
+    mean = (w0["w"] - 3 * g["w"]).mean(axis=0)
+    for k in range(K):
+        np.testing.assert_allclose(p[k], mean, atol=1e-5, rtol=1e-5)
+    # dense link: everything delivered, momentum fully decoupled to 0
+    np.testing.assert_allclose(st["modules"][0]["momentum"], 0.0,
+                               atol=1e-6)
+
+    p_t, st_t, m_t = run(codec="topk", frac=0.2, outer_lr=1.0,
+                         outer_momentum=0.0)
+    for k in range(1, K):
+        np.testing.assert_array_equal(p_t[k], p_t[0])
+    mom = st_t["modules"][0]["momentum"]
+    assert np.any(mom != 0)          # the slow mass stayed local
+    # comm: the compressed all-reduce convention over the wire bytes
+    from gym_tpu.strategy import CompressedLink
+    wire = CompressedLink("topk", frac=0.2).wire_bytes(n)
+    assert np.all(m_t["comm_bytes"] == pytest.approx(3 / 4 * 2 * wire))
+    assert np.all(m["comm_bytes"] == pytest.approx(3 / 4 * 2 * 4.0 * n))
+
+
+def test_compressed_link_rejects_incoherent_compositions():
+    """codec × shard_outer and codec × participation<1 are physically
+    incoherent (sharded/frozen residuals) — typed rejections, not silent
+    misbehavior."""
+    with pytest.raises(ValueError, match="shard_outer"):
+        DiLoCoStrategy(H=2, codec="int8", shard_outer=True)
+    with pytest.raises(ValueError, match="participation"):
+        DiLoCoStrategy(H=2, codec="int8", participation=0.5)

@@ -130,26 +130,54 @@ def test_grid_bits_axis_multiplies_only_compressed_strategies(tmp_path):
     cfg = _cfg(tmp_path, strategies=["dynamiq", "noloco", "simple_reduce"],
                presets=["wan"], H=[4, 8], bits=[8, 4])
     cells = grid(cfg)
-    # dynamiq × 2 bits, noloco × 2 H, simple_reduce once
+    # dynamiq × 2 bits, noloco × 2 H (default codecs = [dense]),
+    # simple_reduce once
     assert len(cells) == 2 + 2 + 1
-    assert Cell("dynamiq", None, 2, "wan", 8) in cells
-    assert Cell("dynamiq", None, 2, "wan", 4) in cells
+    assert Cell("dynamiq", None, 2, "wan", "int8") in cells
+    assert Cell("dynamiq", None, 2, "wan", "int4") in cells
     assert Cell("noloco", 4, 2, "wan") in cells
-    assert Cell("dynamiq", None, 2, "wan", 8).cell_id \
+    assert Cell("dynamiq", None, 2, "wan", "int8").cell_id \
         == "dynamiq_int8_n2_wan"
+    assert Cell("dynamiq", None, 2, "wan", "int8").bits == 8
     assert Cell("noloco", 4, 2, "wan").cell_id == "noloco_H4_n2_wan"
-    # the headline alias resolves AND pins its named bit-width — --bits
+    # the headline alias resolves AND pins its named codec — --bits
     # cannot silently override what the alias says
     cfg8 = _cfg(tmp_path, strategies=["dynamiq_int8"], presets=["wan"],
                 bits=[4])
     assert cfg8.strategies == ["dynamiq"]
-    assert [c.bits for c in grid(cfg8)] == [8]
+    assert [c.codec for c in grid(cfg8)] == ["int8"]
     # a cell requested both ways runs once
     cfg_dup = _cfg(tmp_path, strategies=["dynamiq", "dynamiq_int8"],
                    presets=["wan"], bits=[8])
     assert len(grid(cfg_dup)) == 1
     with pytest.raises(ValueError, match="unknown bit-width"):
         _cfg(tmp_path, bits=[16])
+
+
+def test_grid_codec_axis_multiplies_the_link_family(tmp_path):
+    """The ISSUE 12 axis: --codecs multiplies the CompressedLink family
+    (diloco/noloco/demo_outer, incl. the dense identity cell), feeds its
+    non-dense entries to dynamiq too, and leaves the codec-free
+    strategies alone."""
+    cfg = _cfg(tmp_path,
+               strategies=["diloco", "noloco", "demo_outer", "dynamiq",
+                           "simple_reduce"],
+               presets=["wan"], H=[4], codecs=["dense", "int4", "topk"])
+    cells = grid(cfg)
+    # 3 link strategies × 3 codecs + dynamiq × (int8 from --bits +
+    # int4/topk from --codecs) + simple_reduce once
+    assert len(cells) == 3 * 3 + 3 + 1
+    assert Cell("diloco", 4, 2, "wan") in cells            # dense
+    assert Cell("diloco", 4, 2, "wan", "int4") in cells
+    assert Cell("noloco", 4, 2, "wan", "topk") in cells
+    assert Cell("demo_outer", 4, 2, "wan", "int4") in cells
+    assert Cell("dynamiq", None, 2, "wan", "topk") in cells
+    assert Cell("noloco", 4, 2, "wan", "int4").cell_id \
+        == "noloco_H4_int4_n2_wan"
+    # dynamiq never gets a dense cell (that's simple_reduce)
+    assert Cell("dynamiq", None, 2, "wan", None) not in cells
+    with pytest.raises(ValueError, match="unknown codec"):
+        _cfg(tmp_path, codecs=["zfp"])
 
 
 def test_pareto_frontier_verdicts_and_csv(tmp_path):
