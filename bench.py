@@ -1345,6 +1345,143 @@ def measure_fleet() -> dict:
     router.close(drain_deadline_s=30)
     m.close()
 
+    # arm (c): the OUT-OF-PROCESS A/B (ISSUE 13) — aggregate tok/s for
+    # 2 in-process thread replicas vs 2 worker SUBPROCESSES, streamed
+    # end to end, on the paged 2-slot config where per-token host work
+    # (paged block bookkeeping, stream fan-out, scheduler loops) is a
+    # first-order cost: that host work shares ONE GIL in the thread
+    # fleet and parallelizes across processes in the subprocess fleet —
+    # the honest 2-core parallelism win. Protocol per the perf-noise
+    # convention: both arms fully warmed (the thread arm seeds the
+    # persistent program tier, so workers spawn at programs_compiled=0),
+    # 5 interleaved passes with alternating order, MEDIANS reported.
+    # The streamed passes also yield the TTFB observable: p99 time to
+    # FIRST BYTE (first chunk at the client) sits next to p99 TTFT
+    # (first token in the engine) and must track it — NOT completion
+    # time, which is what `/generate` cost before streaming.
+    import statistics
+
+    from gym_tpu import programs as programs_mod
+    from gym_tpu.serve.router import build_process_fleet
+
+    cache_dir = tempfile.mkdtemp(prefix="gym_tpu_fleet_cache_")
+    programs_mod.enable_disk_tier(cache_dir)
+    ab_rng = np.random.default_rng(1)
+    ab_wl = [
+        (ab_rng.integers(0, cfg.vocab_size,
+                         int(ab_rng.integers(16, 48))),
+         SamplingParams(max_new_tokens=int(ab_rng.integers(12, 28)),
+                        temperature=0.9, top_k=16, seed=100 + i))
+        for i in range(48)]
+    ab_tokens = sum(sp.max_new_tokens for _, sp in ab_wl)
+    ab_kw = dict(replicas=2, num_slots=2, decode_chunk=1, max_queue=64,
+                 page_size=16, kv_pages=64, dispatch_timeout_s=60.0)
+
+    def run_streamed(router, wl, collect=None):
+        """Drive the workload through streaming clients; optionally
+        collect (ttfb, ttft, completion) triples. Returns wall_s."""
+
+        def client(arg):
+            prompt, sp = arg
+            fr = router.submit(prompt, sp, timeout=120.0)
+            got = 0
+            for chunk in fr.stream(timeout=180.0):
+                got += len(chunk)
+            if collect is not None and fr.ttft_s is not None:
+                done = getattr(fr, "done_frame", None) or {}
+                ttft = done.get("ttft_s") or fr.ttft_s
+                collect.append((fr.ttft_s, ttft,
+                                fr.done_t - fr.submit_t))
+            return got == sp.max_new_tokens
+
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(6) as ex:
+            oks = list(ex.map(client, wl))
+        assert all(oks), "process-fleet A/B dropped a stream"
+        return time.perf_counter() - t0
+
+    tm = ServeMetrics(tempfile.mkdtemp(prefix="gym_tpu_abt_"),
+                      engine_log_every=10)
+    thread_router = build_fleet(
+        params_a, cfg, paged=True, metrics=tm,
+        log=lambda *a, **k: None, **ab_kw).start()
+    run_streamed(thread_router, ab_wl)     # warm + seed the disk tier
+    run_streamed(thread_router, ab_wl)
+    pm = ServeMetrics(tempfile.mkdtemp(prefix="gym_tpu_abp_"),
+                      engine_log_every=10)
+    proc_router = build_process_fleet(
+        params_a, cfg, tempfile.mkdtemp(prefix="gym_tpu_abf_"),
+        metrics=pm, program_cache_dir=cache_dir, no_warmup=True,
+        log=lambda *a, **k: None, **ab_kw)
+    proc_router.start()
+    proc_router.wait_ready(timeout_s=240)
+    run_streamed(proc_router, ab_wl)       # warm the wire path
+    run_streamed(proc_router, ab_wl)
+    lat = []       # (ttfb, ttft, completion) from proc streamed passes
+    t_rates, p_rates = [], []
+    for i in range(5):
+        arms = ([("p", proc_router), ("t", thread_router)]
+                if i % 2 == 0 else
+                [("t", thread_router), ("p", proc_router)])
+        for tag, r in arms:
+            wall = run_streamed(r, ab_wl,
+                                collect=lat if tag == "p" else None)
+            (p_rates if tag == "p" else t_rates).append(
+                ab_tokens / wall)
+    thread_tok_s = statistics.median(t_rates)
+    proc_tok_s = statistics.median(p_rates)
+    ttfbs = np.asarray([x[0] for x in lat])
+    ttfts = np.asarray([x[1] for x in lat])
+    comps = np.asarray([x[2] for x in lat])
+    p99_ttfb = float(np.percentile(ttfbs, 99))
+    p99_ttft = float(np.percentile(ttfts, 99))
+    p99_completion = float(np.percentile(comps, 99))
+    p50_completion = float(np.percentile(comps, 50))
+    # PER-REQUEST delta between first byte at the client and first
+    # token in the engine: wire + dispatch overhead only. Tail-vs-tail
+    # comparisons use the same request population on both sides.
+    delta_med = float(np.median(ttfbs - ttfts))
+    # structural: streamed TTFB is FIRST-TOKEN time, not completion
+    # time — the whole point of streaming. It must track TTFT (a small
+    # per-request wire/dispatch delta; tails aligned) and precede the
+    # completion tail.
+    assert delta_med <= 0.1, (
+        f"median TTFB-TTFT delta {delta_med:.3f}s — chunk delivery is "
+        f"lagging the engine")
+    assert p99_ttfb <= p99_ttft * 1.5 + 0.2, (
+        f"p99 TTFB {p99_ttfb:.3f}s does not track p99 TTFT "
+        f"{p99_ttft:.3f}s")
+    assert p99_ttfb < p99_completion, (
+        f"p99 TTFB {p99_ttfb:.3f}s not under p99 completion "
+        f"{p99_completion:.3f}s — streaming is buffering")
+    proc_status = proc_router.status()
+    worker_compiles = [r.get("programs_compiled")
+                       for r in proc_status["replicas"]
+                       if not r["retired"]]
+    thread_router.close(drain_deadline_s=30)
+    proc_router.close(drain_deadline_s=30)
+    tm.close()
+    pm.close()
+    process_ab = {
+        "status": "measured",
+        "measured": True,
+        "workload": ("48 streamed requests (prompt_len in [16,48), "
+                     "max_new in [12,28)), paged page 16, 2 replicas "
+                     "x 2 slots, chunk 1, 6 client threads; medians "
+                     "of 5 interleaved passes after 2 warm passes "
+                     "per arm"),
+        "thread_fleet_tok_s": round(thread_tok_s, 1),
+        "process_fleet_tok_s": round(proc_tok_s, 1),
+        "process_over_thread": round(proc_tok_s / thread_tok_s, 3),
+        "p99_ttfb_s": round(p99_ttfb, 5),
+        "p99_ttft_s": round(p99_ttft, 5),
+        "ttfb_minus_ttft_median_s": round(delta_med, 5),
+        "p99_completion_s": round(p99_completion, 5),
+        "p50_completion_s": round(p50_completion, 5),
+        "worker_programs_compiled": worker_compiles,
+        "streams_spliced_failovers": proc_status["failovers"],
+    }
+
     return {
         "metric": "fleet_failover_and_hot_swap",
         "status": "measured",
@@ -1355,6 +1492,7 @@ def measure_fleet() -> dict:
                      f"chunk 2"),
         "replica_kill": kill_arm,
         "hot_swap": swap_arm,
+        "process_ab": process_ab,
     }
 
 

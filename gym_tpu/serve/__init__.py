@@ -40,20 +40,35 @@ concurrent load:
 - ``metrics``: per-request TTFT / per-token latency and engine
   tokens/s / queue depth / slot occupancy, logged CSVLogger-style to
   ``serve.csv``.
+- ``wire`` / ``worker`` / ``autoscale``: the OUT-OF-PROCESS fleet tier
+  (ISSUE 13) — each replica a real subprocess (its own GIL, its own
+  failure domain) speaking a length-prefixed JSON frame protocol over a
+  local socket (submit / streamed chunk / health / reload / stop), the
+  router's ``ProcessRouter`` as a thin async dispatcher with the SAME
+  failover semantics upgraded to streaming (mid-stream replica death
+  splices the re-derived token stream byte-identically), and a
+  load-adaptive autoscaler spawning/retiring replica processes from the
+  per-replica tokens/s EWMAs and backlog.
 - ``__main__``: ``python -m gym_tpu.serve --ckpt <run_dir>`` — a
-  stdlib-HTTP entrypoint with graceful SIGTERM drain.
+  stdlib-HTTP entrypoint with graceful SIGTERM drain, token streaming
+  (``"stream": true`` → chunked SSE, TTFB = first-token time), and
+  ``--out-of-process`` / ``--autoscale`` for the process fleet.
 """
 
+from .autoscale import (AutoscaleController, AutoscalePolicy,
+                        Autoscaler)
 from .engine import (BlockAllocator, EngineStats, InferenceEngine,
                      NoFreeBlocksError, SamplingParams)
 from .load import CheckpointWatcher, load_for_serving
 from .metrics import ReplicaMetrics, ServeMetrics
 from .router import (FleetReloadError, FleetRequest,
-                     NoHealthyReplicaError, Replica, Router, build_fleet)
+                     NoHealthyReplicaError, ProcessReplica,
+                     ProcessRouter, ProcRequest, Replica, Router,
+                     WorkerSpawner, build_fleet, build_process_fleet)
 from .scheduler import (AdmissionRejectedError, DeadlineExceededError,
                         EngineFailedError, QueueFullError, Request,
-                        RequestStatus, Scheduler, SchedulerClosedError,
-                        SlotQuarantinedError)
+                        RequestCancelledError, RequestStatus, Scheduler,
+                        SchedulerClosedError, SlotQuarantinedError)
 from .supervisor import Supervisor
 
 __all__ = [
@@ -62,9 +77,12 @@ __all__ = [
     "Scheduler", "Request", "RequestStatus", "QueueFullError",
     "SchedulerClosedError", "DeadlineExceededError",
     "AdmissionRejectedError", "EngineFailedError",
-    "SlotQuarantinedError", "Supervisor",
+    "SlotQuarantinedError", "RequestCancelledError", "Supervisor",
     "Router", "Replica", "FleetRequest", "build_fleet",
     "NoHealthyReplicaError", "FleetReloadError",
+    "ProcessRouter", "ProcessReplica", "ProcRequest", "WorkerSpawner",
+    "build_process_fleet",
+    "AutoscalePolicy", "AutoscaleController", "Autoscaler",
     "load_for_serving", "CheckpointWatcher",
     "ServeMetrics", "ReplicaMetrics",
 ]

@@ -74,6 +74,7 @@ import os
 import signal
 import sys
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
@@ -100,6 +101,27 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="engine replicas behind the health-aware router "
                         "(fleet serving: failover + rolling weight "
                         "hot-swap need >= 2)")
+    p.add_argument("--out-of-process", action="store_true",
+                   help="run each replica as a worker SUBPROCESS over a "
+                        "local socket (its own GIL, its own failure "
+                        "domain) instead of an in-process thread stack; "
+                        "responses can stream and a killed replica "
+                        "process splices mid-stream onto a sibling")
+    p.add_argument("--autoscale", action="store_true",
+                   help="with --out-of-process: spawn/retire replica "
+                        "processes from the live per-replica tokens/s "
+                        "EWMAs and backlog (bounds: --min-replicas/"
+                        "--max-replicas); also respawns killed workers")
+    p.add_argument("--min-replicas", type=int, default=None,
+                   help="autoscaler floor (default: --replicas)")
+    p.add_argument("--max-replicas", type=int, default=None,
+                   help="autoscaler ceiling (default: "
+                        "max(--replicas, 4))")
+    p.add_argument("--autoscale-interval", type=float, default=1.0,
+                   help="autoscaler tick interval in seconds")
+    p.add_argument("--worker-startup-timeout", type=float, default=240.0,
+                   help="seconds to wait for spawned worker processes "
+                        "to come healthy at startup")
     p.add_argument("--failover-retries", type=int, default=None,
                    help="per-request failover re-dispatch budget "
                         "(default: min(2, replicas-1) — a single "
@@ -194,6 +216,7 @@ class ServerHandle:
     info: Dict[str, Any]
     router: Any = None
     warmup: Any = None
+    autoscaler: Any = None
 
     @property
     def port(self) -> int:
@@ -215,8 +238,12 @@ class ServerHandle:
         """Test-path teardown: stop every replica's driver, drain it
         (wedged replicas get their stacks dumped and their requests
         failed typed — handler threads blocked in result() must not pin
-        server_close open), close sockets."""
+        server_close open), close sockets. Process fleets additionally
+        stop the autoscaler first (no respawns during teardown) and
+        reap every worker child."""
         self.stop_warmup()
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         self.router.close(drain_deadline_s=drain_deadline_s)
         self.httpd.shutdown()
         self.httpd.server_close()
@@ -236,7 +263,16 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
                   failover_retries: Optional[int] = None,
                   reload_source: Optional[Any] = None,
                   warmup: bool = True,
-                  program_cache_dir: Optional[str] = None) -> ServerHandle:
+                  program_cache_dir: Optional[str] = None,
+                  out_of_process: bool = False,
+                  autoscale: bool = False,
+                  min_replicas: Optional[int] = None,
+                  max_replicas: Optional[int] = None,
+                  autoscale_interval_s: float = 1.0,
+                  fleet_dir: Optional[str] = None,
+                  worker_startup_timeout_s: float = 240.0,
+                  worker_env: Optional[Dict[str, str]] = None
+                  ) -> ServerHandle:
     """Build the full serving stack — replica fleet (engines, schedulers,
     supervisors, router), metrics, HTTP server — WITHOUT entering
     ``serve_forever``. ``main`` and the in-process chaos tests share
@@ -259,13 +295,15 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
     from ..data.build_dataset import CHAR_VOCAB
     from ..utils.checkpoint import CheckpointNotFoundError
     from ..utils.resilience import fault_point
+    from .autoscale import AutoscalePolicy, Autoscaler
     from .engine import SamplingParams
     from .metrics import ServeMetrics
     from .router import (FleetReloadError, NoHealthyReplicaError,
-                         build_fleet)
+                         build_fleet, build_process_fleet)
     from .scheduler import (AdmissionRejectedError, DeadlineExceededError,
                             EngineFailedError, QueueFullError,
-                            SchedulerClosedError, SlotQuarantinedError)
+                            RequestCancelledError, SchedulerClosedError,
+                            SlotQuarantinedError)
 
     info = dict(info or {"step": None, "num_nodes": None})
     stop = stop_event or threading.Event()
@@ -297,30 +335,70 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
             f"gym_tpu.serve: program registry disk tier at {resolved}\n")
 
     metrics = ServeMetrics(metrics_dir)
-    # the params live in memory (restored from the checkpoint at
-    # startup); the process-wide device-program registry makes every
-    # replica's engine — and any failover/hot-swap rebuild — warm:
-    # same config, no recompiles
-    router = build_fleet(
-        params, cfg, replicas=replicas, num_slots=num_slots,
-        decode_chunk=decode_chunk, paged=paged,
-        page_size=page_size or 16, kv_pages=kv_pages,
-        spec_tokens=spec_tokens if paged else 0, max_queue=max_queue,
-        metrics=metrics, dispatch_timeout_s=dispatch_timeout,
-        max_restarts=max_restarts, max_failovers=failover_retries,
-        weights_tag=(f"step-{info['step']}"
-                     if info.get("step") is not None else None))
-    rep0 = router.replicas[0]
-    sched, sup = rep0.scheduler, rep0.supervisor
+    weights_tag = (f"step-{info['step']}"
+                   if info.get("step") is not None else None)
+    autoscaler = None
     warm_thread = None
-    if warmup:
-        # background AOT warmup over ONE replica's program family — all
-        # replicas share config, so one pass warms the whole fleet (and
-        # any future failover rebuild / hot-swap generation) through the
-        # shared registry; a request arriving mid-warmup single-flights
-        # into the same build instead of compiling twice
-        warm_thread = programs_mod.warm_engine_programs(
-            rep0.scheduler.engine, log=sys.stderr.write)
+    if out_of_process:
+        # process fleet: each replica is a worker SUBPROCESS speaking
+        # the wire protocol over a unix socket in a private runtime
+        # dir; the parent materializes the params snapshot once and
+        # every worker loads it (and warms ITSELF — with a persistent
+        # --program-cache-dir a spawned worker deserializes its whole
+        # program family: programs_compiled=0)
+        import tempfile
+        base = fleet_dir or tempfile.mkdtemp(prefix="gym_tpu_fleet_")
+        router = build_process_fleet(
+            params, cfg, base, replicas=replicas, num_slots=num_slots,
+            decode_chunk=decode_chunk,
+            page_size=(page_size or 16) if paged else 0,
+            kv_pages=kv_pages,
+            spec_tokens=spec_tokens if paged else 0,
+            max_queue=max_queue, metrics=metrics,
+            dispatch_timeout_s=dispatch_timeout,
+            max_restarts=max_restarts, max_failovers=failover_retries,
+            weights_tag=weights_tag,
+            program_cache_dir=program_cache_dir,
+            no_warmup=not warmup, device=None, env=worker_env,
+            log=lambda *a, **k: print(*a, file=sys.stderr, flush=True))
+        router.start()
+        router.wait_ready(n=replicas,
+                          timeout_s=worker_startup_timeout_s)
+        if autoscale:
+            lo = replicas if min_replicas is None else int(min_replicas)
+            hi = (max(replicas, 4) if max_replicas is None
+                  else int(max_replicas))
+            autoscaler = Autoscaler(
+                router,
+                AutoscalePolicy(min_replicas=lo, max_replicas=hi),
+                interval_s=autoscale_interval_s,
+                log=lambda *a, **k: print(*a, file=sys.stderr,
+                                          flush=True)).start()
+        sched = sup = None
+    else:
+        # the params live in memory (restored from the checkpoint at
+        # startup); the process-wide device-program registry makes every
+        # replica's engine — and any failover/hot-swap rebuild — warm:
+        # same config, no recompiles
+        router = build_fleet(
+            params, cfg, replicas=replicas, num_slots=num_slots,
+            decode_chunk=decode_chunk, paged=paged,
+            page_size=page_size or 16, kv_pages=kv_pages,
+            spec_tokens=spec_tokens if paged else 0, max_queue=max_queue,
+            metrics=metrics, dispatch_timeout_s=dispatch_timeout,
+            max_restarts=max_restarts, max_failovers=failover_retries,
+            weights_tag=weights_tag)
+        rep0 = router.replicas[0]
+        sched, sup = rep0.scheduler, rep0.supervisor
+        if warmup:
+            # background AOT warmup over ONE replica's program family —
+            # all replicas share config, so one pass warms the whole
+            # fleet (and any future failover rebuild / hot-swap
+            # generation) through the shared registry; a request
+            # arriving mid-warmup single-flights into the same build
+            # instead of compiling twice
+            warm_thread = programs_mod.warm_engine_programs(
+                rep0.scheduler.engine, log=sys.stderr.write)
     char_level = cfg.vocab_size <= len(CHAR_VOCAB) + 1
 
     def encode_text(text: str):
@@ -355,6 +433,9 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
         def do_GET(self):
             if self.path not in ("/stats", "/healthz"):
                 self._reply(404, {"error": f"unknown path {self.path}"})
+                return
+            if getattr(router, "kind", "thread") == "process":
+                self._stats_process()
                 return
             fleet = router.status()
             engines = [rep.scheduler.engine for rep in router.replicas]
@@ -428,6 +509,46 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
                 **fleet,
             })
 
+        def _stats_process(self):
+            """/stats for the OUT-OF-PROCESS fleet: the router process
+            holds no engines — per-replica engine samples come from the
+            workers' health frames (cached by the dispatcher's reader
+            loop), each entry carrying the worker ``pid`` and its OWN
+            ``programs_compiled`` (the spawn-cheapness observable the
+            ci_serve drill pins at 0 against a warm cache dir)."""
+            fleet = router.status()
+            live = [r for r in fleet["replicas"] if not r["retired"]]
+            head = metrics.headline()
+            head.pop("replicas", None)
+            # degraded = fewer healthy workers than the fleet's floor
+            # (dead replicas stay listed for the post-mortem, but a
+            # respawned fleet is OK again — alerts must clear)
+            floor = (autoscaler.policy.min_replicas
+                     if autoscaler is not None else replicas)
+            self._reply(200, {
+                **head,
+                "status": ("draining" if stop.is_set() else
+                           "degraded"
+                           if fleet["healthy_replicas"] < floor
+                           else "ok"),
+                "step": info["step"],
+                "num_slots": sum(r.get("num_slots") or 0
+                                 for r in live if r["healthy"]),
+                "active_slots": sum(r.get("active_slots") or 0
+                                    for r in live),
+                "queue_depth": sum(r.get("queue_depth") or 0
+                                   for r in live),
+                "tokens_generated": sum(r.get("tokens_generated") or 0
+                                        for r in live),
+                # the ROUTER process's own compile counter (should stay
+                # ~0: it dispatches, it does not decode); per-replica
+                # programs_compiled lives in each replicas[] entry
+                "programs_compiled": programs_mod.xla_compile_counter(),
+                "autoscaler": (autoscaler.status()
+                               if autoscaler is not None else None),
+                **fleet,
+            })
+
         def do_POST(self):
             if self.path == "/reload":
                 self._do_reload()
@@ -475,6 +596,7 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
                                     self.headers.get("X-Deadline-S"))
                 deadline = (default_deadline if deadline is None
                             else float(deadline))
+                stream = bool(body.get("stream", False))
             except (ValueError, KeyError, TypeError) as e:
                 self._reply(400, {"error": str(e)})
                 return
@@ -483,8 +605,14 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
                             retry_after_s=1.0)
                 return
             try:
+                # the process router skips per-chunk wire frames for
+                # result-only requests; the in-process router has no
+                # such knob (tokens are already shared memory)
+                submit_kw = ({"stream": stream}
+                             if getattr(router, "kind", "") == "process"
+                             else {})
                 req = router.submit(prompt, sp, timeout=30.0,
-                                    deadline_s=deadline)
+                                    deadline_s=deadline, **submit_kw)
             except AdmissionRejectedError as e:
                 self._reply(429, {"error": str(e)},
                             retry_after_s=e.retry_after_s)
@@ -513,6 +641,9 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
             wait_s = request_timeout
             if deadline is not None:
                 wait_s = min(wait_s, deadline + 5.0)
+            if stream:
+                self._stream_reply(req, prompt, wait_s)
+                return
             try:
                 tokens = req.result(timeout=wait_s)
             except DeadlineExceededError as e:
@@ -561,6 +692,77 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
             if char_level:
                 out["text"] = decode_text(tokens)
             self._reply(200, out)
+
+        def _sse(self, obj: dict) -> None:
+            self.wfile.write(b"data: " + json.dumps(obj).encode()
+                             + b"\n\n")
+            self.wfile.flush()
+
+        def _stream_reply(self, req, prompt, wait_s: float) -> None:
+            """``"stream": true`` — chunked SSE: one ``data:`` event per
+            decode chunk, then a final summary event. TTFB collapses
+            from completion time to FIRST-token time; a mid-stream
+            replica death is spliced by the router (the concatenated
+            events are byte-identical to an uncontended run); a client
+            that disconnects (EPIPE on the chunked write) has its
+            request cancelled at the next decode-chunk boundary and
+            recorded ``status=disconnected`` — never a traceback."""
+            metrics.stream_started()
+            tokens = []
+            try:
+                try:
+                    # header writes can ALREADY raise EPIPE (client
+                    # gone before the first byte) — they must sit
+                    # inside the disconnect guard or the generation
+                    # runs for nobody and the handler tracebacks
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/event-stream")
+                    self.send_header("Cache-Control", "no-cache")
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    for chunk in req.stream(timeout=wait_s):
+                        tokens.extend(chunk)
+                        self._sse({"tokens": chunk,
+                                   "replica": req.replica_id})
+                    out = {"done": True,
+                           "tokens_total": len(tokens),
+                           "prompt_tokens": int(prompt.size),
+                           "ttft_s": (round(req.ttft_s, 5)
+                                      if req.ttft_s is not None
+                                      else None),
+                           "latency_s": (round(req.done_t - req.submit_t,
+                                               5)
+                                         if req.done_t is not None
+                                         else None),
+                           "replica": req.replica_id,
+                           "failovers": req.failovers}
+                    if char_level:
+                        out["text"] = decode_text(tokens)
+                    self._sse(out)
+                except (BrokenPipeError, ConnectionResetError):
+                    # the client went away mid-stream: cancel at the
+                    # next chunk boundary, free the slot; metrics land
+                    # as status=disconnected via RequestCancelledError
+                    req.cancel(reason="client disconnected mid-stream")
+                    self.close_connection = True
+                except (DeadlineExceededError, TimeoutError,
+                        AdmissionRejectedError, QueueFullError,
+                        EngineFailedError, SlotQuarantinedError,
+                        SchedulerClosedError, NoHealthyReplicaError,
+                        RequestCancelledError, OSError,
+                        RuntimeError) as e:
+                    # headers are gone — the typed failure travels as a
+                    # terminal SSE event instead of a status code
+                    try:
+                        self._sse({"error": str(e),
+                                   "error_type": type(e).__name__,
+                                   "tokens_total": len(tokens)})
+                    except (BrokenPipeError, ConnectionResetError):
+                        req.cancel(reason="client disconnected")
+                        self.close_connection = True
+            finally:
+                metrics.stream_ended()
 
         def _do_reload(self):
             """Zero-downtime weight hot-swap over HTTP: re-read the
@@ -622,11 +824,16 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
     # every accepted request gets its JSON reply before the process exits
     httpd.daemon_threads = False
     httpd.block_on_close = True
-    router.start()
+    if not out_of_process:
+        router.start()        # process fleets started above (their
+        #                       workers need the pre-listen wait)
     return ServerHandle(httpd=httpd, scheduler=sched, supervisor=sup,
                         metrics=metrics,
-                        engine_factory=rep0.engine_factory,
-                        info=info, router=router, warmup=warm_thread)
+                        engine_factory=(None if out_of_process
+                                        else router.replicas[0]
+                                        .engine_factory),
+                        info=info, router=router, warmup=warm_thread,
+                        autoscaler=autoscaler)
 
 
 def main(argv=None) -> int:
@@ -697,7 +904,13 @@ def main(argv=None) -> int:
         failover_retries=getattr(args, "failover_retries"),
         reload_source=reload_source,
         warmup=not getattr(args, "no_warmup"),
-        program_cache_dir=getattr(args, "program_cache_dir"))
+        program_cache_dir=getattr(args, "program_cache_dir"),
+        out_of_process=getattr(args, "out_of_process"),
+        autoscale=getattr(args, "autoscale"),
+        min_replicas=getattr(args, "min_replicas"),
+        max_replicas=getattr(args, "max_replicas"),
+        autoscale_interval_s=getattr(args, "autoscale_interval"),
+        worker_startup_timeout_s=getattr(args, "worker_startup_timeout"))
     httpd, metrics, router = handle.httpd, handle.metrics, handle.router
 
     watcher = None
@@ -726,6 +939,8 @@ def main(argv=None) -> int:
         stop.set()
         if watcher is not None:
             watcher.stop()
+        if handle.autoscaler is not None:
+            handle.autoscaler.stop()   # no respawns during the drain
         handle.stop_warmup()
         # per-replica drain: answer in-flight, fail queued typed; a
         # WEDGED replica gets its thread stacks dumped and its requests
@@ -748,14 +963,21 @@ def main(argv=None) -> int:
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, _on_signal)
 
-    eng = handle.scheduler.engine
-    kv = (f"paged kv: page {eng.page_size} x {eng.kv_pages} pages"
-          + (f", spec {eng.spec_tokens}" if eng.spec_tokens else "")
-          if eng.paged else "unpaged kv")
-    if eng.weights_dtype != "f32" or eng.kv_dtype != "f32":
-        kv += f", quant w={eng.weights_dtype} kv={eng.kv_dtype}"
+    if handle.scheduler is not None:
+        eng = handle.scheduler.engine
+        kv = (f"paged kv: page {eng.page_size} x {eng.kv_pages} pages"
+              + (f", spec {eng.spec_tokens}" if eng.spec_tokens else "")
+              if eng.paged else "unpaged kv")
+        if eng.weights_dtype != "f32" or eng.kv_dtype != "f32":
+            kv += f", quant w={eng.weights_dtype} kv={eng.kv_dtype}"
+        fleet_note = f"{args.replicas} replica(s)"
+    else:
+        kv = "worker-side kv"
+        fleet_note = (f"{args.replicas} worker process(es)"
+                      + (", autoscaling" if handle.autoscaler is not None
+                         else ""))
     print(f"gym_tpu.serve: listening on http://{args.host}:{handle.port} "
-          f"({args.replicas} replica(s) x {args.num_slots} slots, "
+          f"({fleet_note} x {args.num_slots} slots, "
           f"queue {args.max_queue}, {kv}, "
           f"watchdog {getattr(args, 'dispatch_timeout'):.0f}s)", flush=True)
     try:

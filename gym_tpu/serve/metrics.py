@@ -83,6 +83,11 @@ HEADER = [
     # were sampled. Absent in pre-quantization CSVs; read_headline
     # tolerates both (like the paging and fleet schema bumps).
     "weights_dtype", "kv_dtype",
+    # out-of-process fleet (ISSUE 13): which OS process produced the
+    # row's work — the server pid for in-process replicas, the worker
+    # subprocess pid for process replicas. Absent in pre-fleet-process
+    # CSVs; read_headline tolerates both (pinned, per repo convention).
+    "pid",
 ]
 
 #: EWMA smoothing for the live tokens/s estimate (per driver tick with
@@ -118,6 +123,11 @@ def _percentiles(samples, prefix: str) -> Dict[str, Optional[float]]:
 _STATUS_BY_EXC = {
     "DeadlineExceededError": "shed",
     "SlotQuarantinedError": "quarantined",
+    # client went away mid-stream (EPIPE on the chunked write): the
+    # request was cancelled at the next decode-chunk boundary — a
+    # client decision, recorded distinctly and NOT counted as a server
+    # failure
+    "RequestCancelledError": "disconnected",
 }
 
 
@@ -180,19 +190,21 @@ class _ReplicaAgg:
     of ``headline()``). Caller holds the collector's lock."""
 
     __slots__ = ("rate", "done", "failed", "shed", "quarantined",
-                 "rejected", "restarts", "reloads", "tokens_out",
-                 "kv_blocks_in_use", "prefix_hit_blocks",
-                 "spec_accept_rate")
+                 "rejected", "disconnected", "restarts", "reloads",
+                 "tokens_out", "kv_blocks_in_use", "prefix_hit_blocks",
+                 "spec_accept_rate", "pid")
 
     def __init__(self):
         self.rate = _RateState()
         self.done = self.failed = self.shed = 0
         self.quarantined = self.rejected = 0
+        self.disconnected = 0
         self.restarts = self.reloads = 0
         self.tokens_out = 0
         self.kv_blocks_in_use = 0
         self.prefix_hit_blocks = 0
         self.spec_accept_rate: Optional[float] = None
+        self.pid: Optional[int] = None
 
     def headline(self) -> Dict[str, Any]:
         return {
@@ -201,6 +213,7 @@ class _ReplicaAgg:
             "requests_shed": self.shed,
             "requests_quarantined": self.quarantined,
             "requests_rejected": self.rejected,
+            "requests_disconnected": self.disconnected,
             "engine_restarts": self.restarts,
             "engine_reloads": self.reloads,
             "tokens_out": self.tokens_out,
@@ -208,6 +221,7 @@ class _ReplicaAgg:
                                   if self.rate.ewma is not None else None),
             "kv_blocks_in_use": self.kv_blocks_in_use,
             "prefix_hit_blocks": self.prefix_hit_blocks,
+            "pid": self.pid,
         }
 
 
@@ -218,29 +232,36 @@ class ReplicaMetrics:
     this replica (admission control must price a replica's OWN backlog
     against its OWN service rate)."""
 
-    def __init__(self, base: "ServeMetrics", replica_id: int):
+    def __init__(self, base: "ServeMetrics", replica_id: int,
+                 pid: Optional[int] = None):
         self.base = base
         self.replica_id = int(replica_id)
+        # in-process replicas all live in the server process; the
+        # process fleet stamps each worker's own pid
+        self.pid = os.getpid() if pid is None else int(pid)
 
     def request_done(self, req, queue_depth: int,
                      active_slots: int) -> None:
         self.base.request_done(req, queue_depth, active_slots,
-                               replica_id=self.replica_id)
+                               replica_id=self.replica_id, pid=self.pid)
 
     def request_rejected(self, queue_depth: int,
                          active_slots: int) -> None:
         self.base.request_rejected(queue_depth, active_slots,
-                                   replica_id=self.replica_id)
+                                   replica_id=self.replica_id,
+                                   pid=self.pid)
 
     def engine_tick(self, stats, queue_depth: int) -> None:
         self.base.engine_tick(stats, queue_depth,
-                              replica_id=self.replica_id)
+                              replica_id=self.replica_id, pid=self.pid)
 
     def engine_restarted(self) -> None:
-        self.base.engine_restarted(replica_id=self.replica_id)
+        self.base.engine_restarted(replica_id=self.replica_id,
+                                   pid=self.pid)
 
     def engine_reloaded(self) -> None:
-        self.base.engine_reloaded(replica_id=self.replica_id)
+        self.base.engine_reloaded(replica_id=self.replica_id,
+                                  pid=self.pid)
 
     def tokens_per_s_ewma(self) -> Optional[float]:
         return self.base.tokens_per_s_ewma(replica_id=self.replica_id)
@@ -275,8 +296,16 @@ class ServeMetrics:
         self.requests_shed = 0
         self.requests_quarantined = 0
         self.requests_rejected = 0
+        self.requests_disconnected = 0
         self.engine_restarts = 0
         self.engine_reloads = 0
+        # out-of-process fleet counters (ISSUE 13): process-replica
+        # lifecycle (autoscaler spawns/retires + kill-respawns) and the
+        # live count of token streams currently being written to
+        # clients (the HTTP layer gates it around each SSE response)
+        self.replicas_spawned = 0
+        self.replicas_retired = 0
+        self.streams_active = 0
         self.tokens_out = 0
         self._ttft_sum = 0.0
         self._ttft_n = 0
@@ -301,12 +330,42 @@ class ServeMetrics:
     def _now(self) -> float:
         return time.perf_counter() - self._t0
 
-    def replica_view(self, replica_id: int) -> ReplicaMetrics:
+    def replica_view(self, replica_id: int,
+                     pid: Optional[int] = None) -> ReplicaMetrics:
         """Replica-scoped facade for one fleet member's scheduler and
         supervisor (see ``ReplicaMetrics``)."""
         with self._lock:
-            self._replicas.setdefault(int(replica_id), _ReplicaAgg())
-        return ReplicaMetrics(self, replica_id)
+            agg = self._replicas.setdefault(int(replica_id),
+                                            _ReplicaAgg())
+            agg.pid = os.getpid() if pid is None else int(pid)
+        return ReplicaMetrics(self, replica_id, pid=pid)
+
+    # -- process-fleet lifecycle (ISSUE 13) -------------------------------
+
+    def replica_spawned(self, replica_id: Optional[int] = None,
+                        pid: Optional[int] = None) -> None:
+        """A replica worker process was spawned (fleet startup,
+        autoscaler scale-up, or a respawn after a kill)."""
+        with self._lock:
+            self.replicas_spawned += 1
+            rep = self._rep(replica_id)
+            if rep is not None and pid is not None:
+                rep.pid = int(pid)
+
+    def replica_retired(self, replica_id: Optional[int] = None,
+                        pid: Optional[int] = None) -> None:
+        """A replica worker process was drained and stopped
+        (autoscaler scale-down)."""
+        with self._lock:
+            self.replicas_retired += 1
+
+    def stream_started(self) -> None:
+        with self._lock:
+            self.streams_active += 1
+
+    def stream_ended(self) -> None:
+        with self._lock:
+            self.streams_active = max(0, self.streams_active - 1)
 
     def _rep(self, replica_id: Optional[int]) -> Optional[_ReplicaAgg]:
         if replica_id is None:
@@ -316,6 +375,10 @@ class ServeMetrics:
     @staticmethod
     def _rid_cell(replica_id: Optional[int]):
         return "" if replica_id is None else int(replica_id)
+
+    @staticmethod
+    def _pid_cell(pid: Optional[int]):
+        return "" if pid is None else int(pid)
 
     @staticmethod
     def _program_cells() -> List[Any]:
@@ -329,7 +392,8 @@ class ServeMetrics:
                 f"{c['compile_seconds']:.3f}"]
 
     def request_done(self, req, queue_depth: int, active_slots: int,
-                     replica_id: Optional[int] = None) -> None:
+                     replica_id: Optional[int] = None,
+                     pid: Optional[int] = None) -> None:
         with self._lock:
             if self._f.closed:        # straggler after close(): drop it
                 return
@@ -338,17 +402,23 @@ class ServeMetrics:
             if failed:
                 status = _STATUS_BY_EXC.get(
                     type(req.exception).__name__, "failed")
-            self.requests_failed += int(failed)
+            # a disconnect is the CLIENT's decision: its own counter,
+            # never inflating requests_failed (the server did nothing
+            # wrong — ci alerts stay meaningful under churny clients)
+            disconnected = status == "disconnected"
+            self.requests_failed += int(failed and not disconnected)
             self.requests_done += int(not failed)
             self.requests_shed += int(status == "shed")
             self.requests_quarantined += int(status == "quarantined")
+            self.requests_disconnected += int(disconnected)
             self.tokens_out += len(req.tokens)
             rep = self._rep(replica_id)
             if rep is not None:
-                rep.failed += int(failed)
+                rep.failed += int(failed and not disconnected)
                 rep.done += int(not failed)
                 rep.shed += int(status == "shed")
                 rep.quarantined += int(status == "quarantined")
+                rep.disconnected += int(disconnected)
                 rep.tokens_out += len(req.tokens)
             ttft = req.ttft_s
             lat = req.avg_token_latency_s
@@ -368,12 +438,13 @@ class ServeMetrics:
                 "" if lat is None else f"{lat:.5f}",
                 self.tokens_out, f"{self.tokens_per_s():.2f}",
                 "", "", "", self._rid_cell(replica_id), "", "", "",
-                "", "",
+                "", "", self._pid_cell(pid),
             ])
             self._f.flush()
 
     def request_rejected(self, queue_depth: int, active_slots: int,
-                         replica_id: Optional[int] = None) -> None:
+                         replica_id: Optional[int] = None,
+                         pid: Optional[int] = None) -> None:
         """Admission control shed a request before it was enqueued (no
         Request object ever existed — the whole point)."""
         with self._lock:
@@ -388,11 +459,12 @@ class ServeMetrics:
                 queue_depth, active_slots, "", "", "", "",
                 self.tokens_out, f"{self.tokens_per_s():.2f}",
                 "", "", "", self._rid_cell(replica_id), "", "", "",
-                "", "",
+                "", "", self._pid_cell(pid),
             ])
             self._f.flush()
 
-    def engine_restarted(self, replica_id: Optional[int] = None) -> None:
+    def engine_restarted(self, replica_id: Optional[int] = None,
+                         pid: Optional[int] = None) -> None:
         """A supervisor failover rebuilt the engine."""
         with self._lock:
             if self._f.closed:
@@ -407,10 +479,12 @@ class ServeMetrics:
                 f"{self.tokens_per_s():.2f}", "", "", "",
                 self._rid_cell(replica_id), *self._program_cells(),
                 self._weights_dtype or "", self._kv_dtype or "",
+                self._pid_cell(pid),
             ])
             self._f.flush()
 
-    def engine_reloaded(self, replica_id: Optional[int] = None) -> None:
+    def engine_reloaded(self, replica_id: Optional[int] = None,
+                        pid: Optional[int] = None) -> None:
         """A rolling weight hot-swap replaced this engine's params (the
         router drained the replica first — no restart, no failures)."""
         with self._lock:
@@ -426,11 +500,13 @@ class ServeMetrics:
                 f"{self.tokens_per_s():.2f}", "", "", "",
                 self._rid_cell(replica_id), *self._program_cells(),
                 self._weights_dtype or "", self._kv_dtype or "",
+                self._pid_cell(pid),
             ])
             self._f.flush()
 
     def engine_tick(self, stats, queue_depth: int,
-                    replica_id: Optional[int] = None) -> None:
+                    replica_id: Optional[int] = None,
+                    pid: Optional[int] = None) -> None:
         """Per-driver-round sample. ALWAYS updates the tokens/s EWMA
         (admission control reads it live); writes a CSV row only every
         ``engine_log_every``-th call so an idle server doesn't grow the
@@ -474,6 +550,7 @@ class ServeMetrics:
                 kv, ph, ("" if sr is None else f"{sr:.4f}"),
                 self._rid_cell(replica_id), *self._program_cells(),
                 self._weights_dtype or "", self._kv_dtype or "",
+                self._pid_cell(pid),
             ])
 
     def tokens_per_s(self) -> float:
@@ -525,8 +602,12 @@ class ServeMetrics:
                 "requests_shed": self.requests_shed,
                 "requests_quarantined": self.requests_quarantined,
                 "requests_rejected": self.requests_rejected,
+                "requests_disconnected": self.requests_disconnected,
                 "engine_restarts": self.engine_restarts,
                 "engine_reloads": self.engine_reloads,
+                "replicas_spawned": self.replicas_spawned,
+                "replicas_retired": self.replicas_retired,
+                "streams_active": self.streams_active,
                 "tokens_out": self.tokens_out,
                 "wall_s": round(self._now(), 3),
                 "tokens_per_s": round(self.tokens_per_s(), 2),
@@ -599,7 +680,7 @@ def read_headline(path: str) -> Dict[str, Any]:
     pre-fleet CSVs (no such column, like pre-paging CSVs lack the KV
     columns) produce the same fleet-free headline they always did."""
     counts = {"done": 0, "failed": 0, "shed": 0, "quarantined": 0,
-              "rejected": 0}
+              "rejected": 0, "disconnected": 0}
     restarts = reloads = 0
     tokens_out = 0
     last_ts = 0.0
@@ -678,6 +759,7 @@ def read_headline(path: str) -> Dict[str, Any]:
         "requests_shed": counts["shed"],
         "requests_quarantined": counts["quarantined"],
         "requests_rejected": counts["rejected"],
+        "requests_disconnected": counts["disconnected"],
         "engine_restarts": restarts,
         "engine_reloads": reloads,
         "tokens_out": tokens_out,

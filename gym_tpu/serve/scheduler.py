@@ -100,6 +100,13 @@ class RequestFailedError(RuntimeError):
     TYPED exception whenever one exists."""
 
 
+class RequestCancelledError(RuntimeError):
+    """The request was cancelled by its caller — in practice: the HTTP
+    client disconnected mid-stream. The generation stops at the next
+    decode-chunk boundary and the slot is freed; ``serve.csv`` records
+    ``status=disconnected`` (not a failure, not a traceback)."""
+
+
 class RequestStatus(enum.Enum):
     QUEUED = "queued"
     RUNNING = "running"
@@ -125,6 +132,39 @@ class Request:
     done_t: Optional[float] = None
     _event: threading.Event = dataclasses.field(
         default_factory=threading.Event, repr=False)
+    _progress: threading.Condition = dataclasses.field(
+        default_factory=threading.Condition, repr=False)
+
+    def _notify_progress(self) -> None:
+        """Wake streamers: new tokens appended or the request resolved.
+        Called by the scheduler after every mutation a streaming reader
+        cares about (its own Condition — never the scheduler lock)."""
+        with self._progress:
+            self._progress.notify_all()
+
+    def wait_progress(self, seen: int,
+                      timeout: Optional[float] = None
+                      ) -> Tuple[List[int], bool]:
+        """Block until the request holds MORE than ``seen`` tokens or
+        reaches a terminal state (or ``timeout`` elapses — not an
+        error: streaming pollers re-arm). Returns ``(tokens snapshot,
+        terminal)``. The streaming read surface: a streamer keeps its
+        own cursor, calls with it, and ships ``snapshot[seen:]`` —
+        token chunks arrive at decode-chunk granularity because that is
+        when the driver appends. Terminal FAILED is NOT raised here;
+        the caller branches on ``status``/``exception`` so a streaming
+        failover can splice instead of unwinding."""
+        deadline = (None if timeout is None
+                    else time.perf_counter() + timeout)
+        with self._progress:
+            while (len(self.tokens) <= seen
+                   and not self._event.is_set()):
+                rem = (None if deadline is None
+                       else deadline - time.perf_counter())
+                if rem is not None and rem <= 0:
+                    break
+                self._progress.wait(rem)
+        return list(self.tokens), self._event.is_set()
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
         """Block until the request completes; returns the new tokens or
@@ -213,6 +253,11 @@ class Scheduler:
         # shutdown must be able to fail it too — it is in NEITHER
         # collection while the prefill runs
         self._admitting: Optional[Request] = None
+        # request ids cancelled by their caller (client disconnect):
+        # swept at the next decode-chunk boundary alongside the
+        # deadline cancellations — the single-driver contract means a
+        # cancel can NEVER touch the engine from the caller's thread
+        self._cancelled: set = set()
 
     # -- submit side ------------------------------------------------------
 
@@ -320,6 +365,37 @@ class Scheduler:
             if self._admitting is not None:
                 t += self._admitting.sampling.max_new_tokens
             return t
+
+    # -- caller-side cancellation (client disconnect) ---------------------
+
+    def cancel(self, req: Request,
+               reason: str = "client disconnected") -> bool:
+        """Cancel ``req`` on behalf of its caller (the HTTP handler saw
+        EPIPE mid-stream). A QUEUED request is failed immediately (it
+        holds no engine state); a RUNNING one is flagged and the driver
+        cancels it at the NEXT decode-chunk boundary — same mechanics,
+        same granularity as deadline cancellation — freeing the slot.
+        Returns True if the cancel took (False: already resolved). The
+        stored failure is ``RequestCancelledError``, which metrics maps
+        to ``status=disconnected``."""
+        queued = False
+        with self._drained:
+            if req.status in (RequestStatus.DONE, RequestStatus.FAILED):
+                return False
+            if req in self._queue:
+                self._queue.remove(req)
+                if req.deadline_s is not None:
+                    self._queued_deadlines -= 1
+                self._drained.notify_all()
+                queued = True
+            else:
+                # running, or mid-admission (it will be RUNNING by the
+                # time the driver's next sweep sees the flag)
+                self._cancelled.add(req.id)
+        if queued:
+            self._fail(req, RequestCancelledError(
+                f"request {req.id} cancelled while queued — {reason}"))
+        return True
 
     # -- admission pause (rolling weight hot-swap) ------------------------
 
@@ -477,6 +553,8 @@ class Scheduler:
                     admitted += 1
                     if not ev.finished:
                         self._by_slot[slot] = req
+            if not stale and not resolved:
+                req._notify_progress()     # first token: wake streamers
             if resolved and not stale:
                 engine.release(slot)   # same engine; free the row
                 continue
@@ -511,6 +589,7 @@ class Scheduler:
         now = time.perf_counter()
         completed: List[Request] = []
         failed: List[Tuple[Request, BaseException]] = []
+        progressed: List[Request] = []
         with self._lock:
             if self._epoch != epoch:
                 return produced        # stale driver: discard the chunk
@@ -529,14 +608,26 @@ class Scheduler:
                     continue
                 req.tokens.append(ev.token)
                 produced += 1
+                if req not in progressed:
+                    progressed.append(req)
                 if ev.finished:
                     del self._by_slot[ev.slot]
                     completed.append(req)
-            # deadline cancellation at the chunk boundary: the slot is
-            # freed for the next admit, the partial generation reported
+            # deadline + caller cancellation at the chunk boundary: the
+            # slot is freed for the next admit, the partial generation
+            # reported (or, for a disconnect, silently dropped — the
+            # client is gone)
             for slot, req in list(self._by_slot.items()):
                 dl = req.deadline_t
-                if dl is not None and now > dl:
+                if req.id in self._cancelled:
+                    self._cancelled.discard(req.id)
+                    engine.release(slot)
+                    del self._by_slot[slot]
+                    failed.append((req, RequestCancelledError(
+                        f"request {req.id} cancelled mid-generation "
+                        f"({len(req.tokens)} tokens in) — slot freed at "
+                        f"chunk boundary")))
+                elif dl is not None and now > dl:
                     engine.release(slot)
                     del self._by_slot[slot]
                     failed.append((req, DeadlineExceededError(
@@ -547,6 +638,9 @@ class Scheduler:
             self._complete(req, now)
         for req, exc in failed:
             self._fail(req, exc)
+        if progressed:
+            for req in progressed:
+                req._notify_progress()
         return produced
 
     def _complete(self, req: Request,
@@ -556,7 +650,9 @@ class Scheduler:
                 return
             req.done_t = now if now is not None else time.perf_counter()
             req.status = RequestStatus.DONE
+            self._cancelled.discard(req.id)
         req._event.set()
+        req._notify_progress()
         if self.metrics is not None:
             self.metrics.request_done(
                 req, queue_depth=self.queue_depth(),
@@ -574,7 +670,9 @@ class Scheduler:
                 req.error = error
             req.status = RequestStatus.FAILED
             req.done_t = time.perf_counter()
+            self._cancelled.discard(req.id)
         req._event.set()
+        req._notify_progress()
         if self.metrics is not None:
             self.metrics.request_done(
                 req, queue_depth=self.queue_depth(),

@@ -23,7 +23,15 @@
 #      sibling, full token stream), /stats must record failovers>=1
 #      with the dead replica excluded from dispatch, and SIGTERM must
 #      exit 0 while replica 0's driver is still wedged (per-replica
-#      stack dump, typed queued failures, no engine stepping).
+#      stack dump, typed queued failures, no engine stepping);
+#   5. the PROCESS-KILL drill (ISSUE 13) against `--out-of-process
+#      --replicas 2 --autoscale`: kill -9 the worker SUBPROCESS serving
+#      a stream, mid-stream, under concurrent load — zero dropped
+#      streams (the router splices the re-derived suffix onto a
+#      sibling: the concatenated client stream is byte-identical to an
+#      uncontended run), the autoscaler respawns the dead worker
+#      (/stats shows replicas_spawned/healthy_replicas recovering), and
+#      the SIGTERM drill exits 0 reaping every child (no zombies).
 #
 # CPU-only; sized for the 2-core container.
 #
@@ -299,6 +307,145 @@ grep -q "failover(s)" "$OUT/fleet.log" || {
     cat "$OUT/fleet.log"; exit 1; }
 echo "ci_chaos: replica-kill drill OK (log at $OUT/fleet.log)"
 
+# Layer 5: PROCESS-kill drill (ISSUE 13) — the out-of-process fleet
+# with the autoscaler. kill -9 the worker pid serving a stream,
+# mid-stream, with concurrent streams in flight.
+PORT3=$((PORT + 2))
+env JAX_PLATFORMS=cpu PYTHONPATH="$REPO" \
+    python -m gym_tpu.serve \
+    --ckpt "$OUT/ckpts/ci" --port "$PORT3" --num_slots 2 --device cpu \
+    --out-of-process --replicas 2 --autoscale --min-replicas 2 \
+    --max-replicas 3 --autoscale-interval 0.5 \
+    --program-cache-dir "$OUT/progcache5" --drain-deadline 15 \
+    > "$OUT/procfleet.log" 2>&1 &
+SRV=$!
+for _ in $(seq 1 180); do
+    grep -q "listening" "$OUT/procfleet.log" && break
+    kill -0 "$SRV" 2>/dev/null || { echo "ci_chaos: proc-fleet server died at startup";
+        cat "$OUT/procfleet.log"; exit 1; }
+    sleep 1
+done
+grep -q "listening" "$OUT/procfleet.log" || {
+    echo "ci_chaos: proc-fleet server never started"; kill -9 "$SRV"; exit 1; }
+
+timeout -k 10 300 env GYM_TPU_CI_CHAOS_PORT="$PORT3" python - <<'EOF'
+import concurrent.futures, json, os, signal, time, urllib.request
+
+port = os.environ["GYM_TPU_CI_CHAOS_PORT"]
+base = f"http://127.0.0.1:{port}"
+
+def stats():
+    return json.loads(urllib.request.urlopen(base + "/stats",
+                                             timeout=30).read())
+
+def stream(payload, kill_after_chunks=None, pid_by_rid=None):
+    """Consume one SSE stream; optionally kill -9 the serving worker
+    PROCESS after N chunk events (pids pre-resolved — a /stats round
+    trip inside the loop would let a fast stream finish before the
+    kill lands). Returns (tokens, final_event)."""
+    body = json.dumps(dict(payload, stream=True)).encode()
+    r = urllib.request.urlopen(urllib.request.Request(
+        base + "/generate", body,
+        {"Content-Type": "application/json"}), timeout=180)
+    toks, chunks, fin = [], 0, None
+    for line in r:
+        line = line.strip()
+        if not line.startswith(b"data: "):
+            continue
+        ev = json.loads(line[6:])
+        if ev.get("done") or ev.get("error"):
+            fin = ev
+            break
+        toks.extend(ev["tokens"])
+        chunks += 1
+        if kill_after_chunks is not None and chunks == kill_after_chunks:
+            rid = ev["replica"]
+            pid = pid_by_rid[rid]
+            os.kill(pid, signal.SIGKILL)
+            print(f"ci_chaos: SIGKILLed worker pid {pid} (replica "
+                  f"{rid}) after {chunks} chunks "
+                  f"({len(toks)} tokens)", flush=True)
+            kill_after_chunks = None
+    return toks, fin
+
+req = {"prompt": [1, 2, 3], "max_new_tokens": 24, "top_k": 4,
+       "seed": 7, "deadline_s": 120}
+# uncontended reference stream (deterministic engine)
+ref, fin = stream(req)
+assert fin.get("done") and len(ref) == 24, (ref, fin)
+before = stats()
+assert before["healthy_replicas"] == 2, before["replicas"]
+spawned0 = before["replicas_spawned"]
+
+# under load: two sibling streams in flight while the victim stream's
+# worker process is kill -9'd mid-stream — ZERO dropped streams
+pid_by_rid = {rep["id"]: rep["pid"] for rep in before["replicas"]
+              if not rep["retired"]}
+with concurrent.futures.ThreadPoolExecutor(3) as ex:
+    bg = [ex.submit(stream, {"prompt": [1, 2, 3], "max_new_tokens": 10,
+                             "top_k": 4, "seed": 20 + i,
+                             "deadline_s": 120}) for i in range(2)]
+    toks, fin = stream(req, kill_after_chunks=1, pid_by_rid=pid_by_rid)
+    bg_results = [f.result() for f in bg]
+assert fin.get("done") is True, fin
+assert toks == ref, f"spliced stream diverged:\n  got {toks}\n  ref {ref}"
+assert fin["failovers"] >= 1, fin
+for btoks, bfin in bg_results:
+    assert bfin.get("done") is True and len(btoks) == 10, (btoks, bfin)
+print("ci_chaos: kill -9 mid-stream — spliced stream byte-identical, "
+      f"{fin['failovers']} failover(s), sibling streams intact")
+
+# the autoscaler must respawn the dead worker: healthy_replicas back
+# to 2, replicas_spawned grew
+deadline = time.monotonic() + 120
+st = stats()
+while time.monotonic() < deadline:
+    st = stats()
+    if (st["healthy_replicas"] >= 2
+            and st["replicas_spawned"] > spawned0):
+        break
+    time.sleep(1)
+assert st["healthy_replicas"] >= 2, st["replicas"]
+assert st["replicas_spawned"] > spawned0, (
+    st["replicas_spawned"], spawned0)
+assert st["streams_active"] == 0, st["streams_active"]
+print("ci_chaos: autoscaler respawned —",
+      json.dumps({"replicas_spawned": st["replicas_spawned"],
+                  "healthy_replicas": st["healthy_replicas"],
+                  "failovers": st["failovers"]}))
+
+# and the recovered fleet still serves exact streams
+toks, fin = stream(req)
+assert fin.get("done") and toks == ref, (toks, fin)
+print("ci_chaos: post-respawn stream exact")
+EOF
+rc=$?
+[ "$rc" -ne 0 ] && { echo "ci_chaos: process-kill drill failed";
+    cat "$OUT/procfleet.log"; kill -9 "$SRV"; exit "$rc"; }
+
+grep -q "declared dead" "$OUT/procfleet.log" || {
+    echo "ci_chaos: no worker-death line in proc-fleet log";
+    cat "$OUT/procfleet.log"; exit 1; }
+grep -q "failover: request retried" "$OUT/procfleet.log" || {
+    echo "ci_chaos: no splice-failover line in proc-fleet log";
+    cat "$OUT/procfleet.log"; exit 1; }
+grep -q "autoscaler — scale UP" "$OUT/procfleet.log" || {
+    echo "ci_chaos: no autoscaler respawn line in proc-fleet log";
+    cat "$OUT/procfleet.log"; exit 1; }
+
+# SIGTERM drill: exit 0, clean shutdown, EVERY worker child reaped
+kill -TERM "$SRV"
+wait "$SRV"; rc=$?
+[ "$rc" -ne 0 ] && { echo "ci_chaos: proc-fleet exit rc=$rc after SIGTERM";
+    cat "$OUT/procfleet.log"; exit 1; }
+grep -q "shut down cleanly" "$OUT/procfleet.log" || {
+    echo "ci_chaos: no clean-shutdown line in proc-fleet log";
+    cat "$OUT/procfleet.log"; exit 1; }
+pgrep -f "gym_tpu.serve.worker" > /dev/null && {
+    echo "ci_chaos: leaked worker processes after SIGTERM:";
+    pgrep -af "gym_tpu.serve.worker"; exit 1; }
+echo "ci_chaos: process-kill drill OK (log at $OUT/procfleet.log)"
+
 # bench rider: one-line shed/recovered/percentile headline
 timeout -k 10 600 python "$REPO/bench.py" --chaos-only \
     > "$OUT/chaos_bench.json" 2> "$OUT/chaos_bench.err" || {
@@ -337,11 +484,30 @@ assert kill["failovers"] >= 1 and kill["dead_replicas"] == 1, head
 assert swap["requests_failed"] == 0, head
 assert swap["recompiles_during_swap"] == 0, head
 assert swap["post_swap_params_verified"] is True, head
+# ISSUE 13: the process-fleet A/B — both arms measured, the
+# 2-subprocess fleet at or above the in-process-thread fleet, and
+# streamed p99 TTFB tracking p99 TTFT (not completion time)
+ab = head["process_ab"]
+assert ab["status"] == "measured" and ab["measured"] is True, ab
+# small noise margin on the 2-core box (the measured headline runs
+# 1.2-1.6x; a CI pass within noise of parity is not a regression —
+# the structural asserts inside bench.py still gate the protocol)
+assert ab["process_fleet_tok_s"] >= 0.95 * ab["thread_fleet_tok_s"], (
+    f"2-subprocess fleet {ab['process_fleet_tok_s']} tok/s well under "
+    f"the thread fleet {ab['thread_fleet_tok_s']} tok/s")
+assert ab["p99_ttfb_s"] <= ab["p99_ttft_s"] * 1.5 + 0.2, ab
+assert ab["p99_ttfb_s"] < ab["p99_completion_s"], ab
+assert all(c == 0 for c in ab["worker_programs_compiled"]), (
+    f"spawned workers recompiled: {ab['worker_programs_compiled']}")
 print("ci_chaos: fleet bench ok —", json.dumps({
     "kill_failovers": kill["failovers"],
     "kill_requests_ok": kill["requests_ok"],
     "swap_requests_ok": swap["requests_ok"],
-    "swap_reload_wall_s": swap["reload_wall_s"]}))
+    "swap_reload_wall_s": swap["reload_wall_s"],
+    "thread_fleet_tok_s": ab["thread_fleet_tok_s"],
+    "process_fleet_tok_s": ab["process_fleet_tok_s"],
+    "p99_ttfb_s": ab["p99_ttfb_s"],
+    "p99_ttft_s": ab["p99_ttft_s"]}))
 EOF
 rc=$?
 [ "$rc" -ne 0 ] && exit "$rc"

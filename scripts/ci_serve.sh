@@ -327,5 +327,75 @@ wait "$SRV"; rc=$?
 [ "$rc" -ne 0 ] && { echo "ci_serve: quantized restart exit rc=$rc";
     cat "$OUT/server4.log"; exit 1; }
 
+# Out-of-process fleet drill (ISSUE 13): re-serve the same checkpoint
+# with --out-of-process --replicas 2 against the f32 program cache the
+# first server seeded. Gate: spawned replica WORKER PROCESSES each
+# report programs_compiled=0 in /stats (zero XLA compiles off the warm
+# persistent tier — what makes autoscaler spawns cheap), a streamed
+# request delivers chunked SSE, and SIGTERM reaps both workers (exit 0).
+env JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python -m gym_tpu.serve \
+    --ckpt "$OUT/ckpts/ci" --port "$PORT" --num_slots 2 --device cpu \
+    --out-of-process --replicas 2 \
+    --program-cache-dir "$OUT/progcache" \
+    > "$OUT/server5.log" 2>&1 &
+SRV=$!
+for _ in $(seq 1 180); do
+    grep -q "listening" "$OUT/server5.log" && break
+    kill -0 "$SRV" 2>/dev/null || { echo "ci_serve: process-fleet server died";
+        cat "$OUT/server5.log"; exit 1; }
+    sleep 1
+done
+grep -q "listening" "$OUT/server5.log" || {
+    echo "ci_serve: process-fleet server never started"; kill -9 "$SRV"; exit 1; }
+
+timeout -k 10 180 env GYM_TPU_CI_SERVE_PORT="$PORT" python - <<'EOF'
+import json, os, urllib.request
+
+port = os.environ["GYM_TPU_CI_SERVE_PORT"]
+# streamed request: chunked SSE, done event carries ttft
+body = json.dumps({"prompt": [1, 2, 3], "max_new_tokens": 6,
+                   "top_k": 4, "seed": 0, "stream": True}).encode()
+r = urllib.request.urlopen(urllib.request.Request(
+    f"http://127.0.0.1:{port}/generate", body,
+    {"Content-Type": "application/json"}), timeout=120)
+assert r.headers["Content-Type"] == "text/event-stream", dict(r.headers)
+events = [json.loads(line[6:]) for line in r
+          if line.strip().startswith(b"data: ")]
+toks = [t for e in events if not e.get("done")
+        for t in e.get("tokens", [])]
+fin = events[-1]
+assert fin.get("done") is True and len(toks) == 6, events
+print("ci_serve: process-fleet streamed request ok, ttft", fin["ttft_s"])
+
+stats = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/stats", timeout=10).read())
+assert stats.get("fleet") == "process", stats.get("fleet")
+live = [r for r in stats["replicas"] if not r["retired"]]
+assert len(live) == 2 and stats["healthy_replicas"] == 2, stats["replicas"]
+pids = {r["pid"] for r in live}
+assert len(pids) == 2 and os.getpid() not in pids, pids
+for rep in live:
+    assert rep["programs_compiled"] == 0, (
+        f"worker {rep['id']} (pid {rep['pid']}) compiled "
+        f"{rep['programs_compiled']} programs — persistent tier miss")
+assert stats["replicas_spawned"] == 2, stats["replicas_spawned"]
+print("ci_serve: spawned workers report programs_compiled=0, pids", pids)
+EOF
+rc=$?
+[ "$rc" -ne 0 ] && { echo "ci_serve: process-fleet drill failed";
+    cat "$OUT/server5.log"; kill -9 "$SRV"; exit "$rc"; }
+
+kill -TERM "$SRV"
+wait "$SRV"; rc=$?
+[ "$rc" -ne 0 ] && { echo "ci_serve: process-fleet exit rc=$rc";
+    cat "$OUT/server5.log"; exit 1; }
+grep -q "shut down cleanly" "$OUT/server5.log" || {
+    echo "ci_serve: no clean-shutdown line (process fleet)";
+    cat "$OUT/server5.log"; exit 1; }
+pgrep -f "gym_tpu.serve.worker" > /dev/null && {
+    echo "ci_serve: leaked worker processes:"; pgrep -af "gym_tpu.serve.worker";
+    exit 1; }
+echo "ci_serve: process-fleet drill OK"
+
 echo "ci_serve: OK (log at $OUT/server.log)"
 exit 0

@@ -1,0 +1,284 @@
+"""Wire protocol + autoscaler policy units (ISSUE 13) — no engines, no
+subprocesses, no sockets: the frame codec must reject every malformed
+input TYPED (no hang, no partial-read corruption), and the autoscale
+controller must scale up on a ramp, scale down only through hysteresis,
+and never go below the replica floor — all provable on synthetic
+traces."""
+
+import io
+import struct
+
+import pytest
+
+from gym_tpu.serve import wire
+from gym_tpu.serve.autoscale import (AutoscaleController, AutoscalePolicy,
+                                     Autoscaler)
+from gym_tpu.serve.engine import SamplingParams
+from gym_tpu.serve.scheduler import (AdmissionRejectedError,
+                                     DeadlineExceededError,
+                                     EngineFailedError, QueueFullError,
+                                     RequestCancelledError,
+                                     SchedulerClosedError)
+
+# -- frame codec ----------------------------------------------------------
+
+
+FRAMES = [
+    {"type": "submit", "id": 7, "prompt": [1, 2, 3],
+     "sampling": {"max_new_tokens": 8, "seed": 0},
+     "deadline_s": 12.5, "prefix": []},
+    {"type": "accepted", "id": 7},
+    {"type": "chunk", "id": 7, "tokens": [4, 5, 6]},
+    {"type": "done", "id": 7, "tokens_total": 8, "ttft_s": 0.12},
+    {"type": "error", "id": 7, "error_type": "QueueFullError",
+     "message": "full"},
+    {"type": "cancel", "id": 7},
+    {"type": "health"},
+    {"type": "health_ok", "pid": 1234, "backlog_tokens": 42,
+     "tokens_per_s_ewma": 10.5, "programs_compiled": 0, "dead": False},
+    {"type": "stats", "id": 9},
+    {"type": "stats_ok", "id": 9, "headline": {"requests_done": 3}},
+    {"type": "reload", "id": 10, "params_file": "/x/p.pkl",
+     "tag": "step-8"},
+    {"type": "reload_ok", "id": 10, "wall_s": 0.5},
+    {"type": "stop", "id": 11},
+    {"type": "stop_ok", "id": 11},
+    {"type": "hello", "pid": 1234, "replica_id": 0},
+]
+
+
+def _read(data: bytes):
+    return wire.read_frame(io.BytesIO(data).read)
+
+
+def test_round_trip_every_frame_type():
+    """encode → read yields the identical frame, for ALL frame types;
+    a multi-frame stream parses frame by frame with clean EOF (None)
+    at the boundary."""
+    assert {f["type"] for f in FRAMES} == set(wire.FRAME_TYPES)
+    blob = b"".join(wire.encode_frame(f) for f in FRAMES)
+    buf = io.BytesIO(blob)
+    for want in FRAMES:
+        assert wire.read_frame(buf.read) == want
+    assert wire.read_frame(buf.read) is None      # clean EOF
+
+
+def test_truncated_frames_rejected_typed():
+    """EOF inside the length prefix OR inside the payload is a typed
+    TruncatedFrameError — never a hang, never a half-frame returned."""
+    enc = wire.encode_frame({"type": "chunk", "id": 1,
+                             "tokens": list(range(50))})
+    for cut in (1, 3, 4, 10, len(enc) - 1):
+        with pytest.raises(wire.TruncatedFrameError):
+            _read(enc[:cut])
+
+
+def test_oversized_frames_rejected_before_payload_read():
+    """A corrupt length prefix over the cap is refused from the prefix
+    alone — the reader must never allocate the claimed payload."""
+    evil = struct.pack(">I", wire.MAX_FRAME_BYTES + 1)
+    reads = {"n": 0}
+
+    def recv(n):
+        reads["n"] += 1
+        return evil[4 * (reads["n"] - 1):4 * reads["n"]]
+
+    with pytest.raises(wire.FrameTooLargeError):
+        wire.read_frame(recv)
+    assert reads["n"] <= 2       # the prefix only — payload never read
+    with pytest.raises(wire.FrameTooLargeError):
+        wire.encode_frame({"type": "chunk", "id": 1,
+                           "tokens": "x" * (wire.MAX_FRAME_BYTES + 1)})
+
+
+def test_malformed_frames_rejected_typed():
+    for bad in (b"not json at all", b"[1,2,3]", b'"str"',
+                b'{"type": "no-such-type"}', b'{"no": "type"}'):
+        with pytest.raises(wire.MalformedFrameError):
+            _read(struct.pack(">I", len(bad)) + bad)
+    with pytest.raises(wire.MalformedFrameError):
+        wire.encode_frame({"type": "nope"})
+    with pytest.raises(wire.MalformedFrameError):
+        wire.encode_frame(["not", "a", "dict"])
+    with pytest.raises(wire.MalformedFrameError):
+        wire.encode_frame({"type": "chunk", "bad": object()})
+
+
+def test_exception_round_trip_preserves_type_and_retry_hint():
+    """Scheduler failures cross the socket TYPED: same class, same
+    message, admission rejects keep their Retry-After hint."""
+    cases = [
+        AdmissionRejectedError("infeasible", retry_after_s=3.5),
+        QueueFullError("full"),
+        DeadlineExceededError("late"),
+        EngineFailedError("died"),
+        SchedulerClosedError("closing"),
+        RequestCancelledError("gone"),
+        ValueError("bad prompt"),
+    ]
+    for exc in cases:
+        back = wire.frame_to_exception(wire.exception_to_frame(5, exc))
+        assert type(back) is type(exc)
+        assert str(exc) in str(back)
+    rej = wire.frame_to_exception(wire.exception_to_frame(
+        5, AdmissionRejectedError("x", retry_after_s=3.5)))
+    assert rej.retry_after_s == 3.5
+    # unknown worker-side classes degrade to a RETRYABLE engine failure
+    weird = wire.frame_to_exception(
+        {"type": "error", "error_type": "SomethingNovel", "message": "?"})
+    assert isinstance(weird, EngineFailedError)
+
+
+def test_sampling_params_round_trip():
+    sp = SamplingParams(max_new_tokens=17, temperature=0.7, top_k=9,
+                        top_p=0.95, eos_token=2, seed=42)
+    assert wire.sampling_from_dict(wire.sampling_to_dict(sp)) == sp
+    assert wire.sampling_from_dict({}) == SamplingParams()
+
+
+# -- autoscaler policy ----------------------------------------------------
+
+
+def _drive(ctrl, ticks):
+    """Feed (healthy, starting, backlog, rate) tuples; apply decisions
+    to a virtual fleet so traces read like reality. Returns the healthy
+    trajectory and decisions."""
+    healthy, starting = ticks[0][0], ticks[0][1]
+    decisions = []
+    for (_h, _s, backlog, rate) in ticks:
+        d = ctrl.tick(healthy, starting, backlog, rate)
+        decisions.append(d)
+        if d > 0:
+            starting += 1
+        elif d < 0:
+            healthy -= 1
+        # spawned workers come healthy after one tick (synthetic)
+        healthy += starting
+        starting = 0
+    return healthy, decisions
+
+
+def test_scale_up_on_sustained_ramp_not_on_blip():
+    p = AutoscalePolicy(min_replicas=1, max_replicas=3, up_patience=2,
+                        cooldown=2)
+    ctrl = AutoscaleController(p)
+    # one over-watermark blip: no action (patience=2)
+    assert ctrl.tick(1, 0, 1000.0, 10.0) == 0
+    assert ctrl.tick(1, 0, 1.0, 10.0) == 0       # back under: reset
+    assert ctrl.tick(1, 0, 1000.0, 10.0) == 0
+    # second consecutive over tick: scale up
+    assert ctrl.tick(1, 0, 1000.0, 10.0) == +1
+    # cooldown holds even under continued pressure
+    assert ctrl.tick(1, 1, 1000.0, 10.0) == 0
+    assert ctrl.tick(1, 1, 1000.0, 10.0) == 0
+
+
+def test_starting_workers_count_toward_capacity():
+    """Never spawn a third replica because the second is still
+    importing jax: `starting` suppresses further up decisions at the
+    max bound."""
+    p = AutoscalePolicy(min_replicas=1, max_replicas=2, up_patience=1,
+                        cooldown=0)
+    ctrl = AutoscaleController(p)
+    assert ctrl.tick(1, 0, 1000.0, 10.0) == +1
+    for _ in range(5):       # worker still starting: at max, hold
+        assert ctrl.tick(1, 1, 1000.0, 10.0) == 0
+
+
+def test_scale_down_needs_hysteresis_and_respects_min():
+    p = AutoscalePolicy(min_replicas=2, max_replicas=4, down_patience=3,
+                        cooldown=0)
+    ctrl = AutoscaleController(p)
+    # idle at 3 replicas: only the THIRD consecutive under-tick retires
+    assert ctrl.tick(3, 0, 0.0, 50.0) == 0
+    assert ctrl.tick(3, 0, 0.0, 50.0) == 0
+    assert ctrl.tick(3, 0, 0.0, 50.0) == -1
+    # at the floor: idle forever, never another retire
+    ctrl2 = AutoscaleController(p)
+    for _ in range(20):
+        assert ctrl2.tick(2, 0, 0.0, 50.0) == 0   # never below min
+
+
+def test_kill_below_min_respawns_immediately_ignoring_cooldown():
+    p = AutoscalePolicy(min_replicas=2, max_replicas=4, cooldown=8)
+    ctrl = AutoscaleController(p)
+    # a kill -9 drops healthy under the floor: respawn NOW (this is
+    # the ci_chaos layer-5 recovery path)
+    assert ctrl.tick(1, 0, 0.0, None) == +1
+    # replacement starting: floor satisfied, cooldown applies again
+    assert ctrl.tick(1, 1, 0.0, None) == 0
+    # both workers gone at once: two consecutive respawns
+    ctrl2 = AutoscaleController(p)
+    assert ctrl2.tick(0, 0, 0.0, None) == +1
+    assert ctrl2.tick(0, 1, 0.0, None) == +1
+
+
+def test_cold_fleet_uses_backlog_watermark_fallback():
+    p = AutoscalePolicy(min_replicas=1, max_replicas=3, up_patience=2,
+                        up_backlog_tokens_per_replica=100.0, cooldown=0)
+    ctrl = AutoscaleController(p)
+    # no EWMA yet (rate None): per-replica backlog watermark decides
+    assert ctrl.tick(1, 0, 500.0, None) == 0
+    assert ctrl.tick(1, 0, 500.0, None) == +1
+
+
+def test_ramp_trace_end_to_end():
+    """A diurnal-ish trace: ramp up under load, plateau, ramp down —
+    the controller lands back at min without ever exceeding max."""
+    p = AutoscalePolicy(min_replicas=1, max_replicas=3, up_patience=2,
+                        down_patience=3, cooldown=1)
+    ctrl = AutoscaleController(p)
+    trace = ([(1, 0, 800.0, 20.0)] * 6        # ramp: drain 40 s >> 4 s
+             + [(3, 0, 100.0, 60.0)] * 4      # plateau: ~1.7 s, in band
+             + [(3, 0, 0.0, 60.0)] * 12)      # idle: drain 0 s
+    healthy, decisions = _drive(ctrl, trace)
+    assert decisions.count(+1) >= 1
+    assert decisions.count(-1) >= 1
+    assert 1 <= healthy <= 3
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=0)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(up_drain_s=1.0, down_drain_s=2.0)
+
+
+def test_autoscaler_thread_drives_router_stub():
+    """The Autoscaler wrapper acts on a router stub: respawn below
+    min, retire on sustained idle — no subprocesses anywhere."""
+
+    class StubRouter:
+        def __init__(self):
+            self.healthy = 1
+            self.ups = 0
+            self.downs = 0
+
+        def autoscale_snapshot(self):
+            return {"healthy": self.healthy, "starting": 0,
+                    "backlog_tokens": 0.0, "tokens_per_s": 10.0}
+
+        def scale_up(self):
+            self.ups += 1
+            self.healthy += 1
+            return type("R", (), {"id": self.healthy})()
+
+        def scale_down(self):
+            self.downs += 1
+            self.healthy -= 1
+            return type("R", (), {"id": self.healthy})()
+
+    stub = StubRouter()
+    asc = Autoscaler(stub, AutoscalePolicy(min_replicas=2,
+                                           max_replicas=3,
+                                           down_patience=2,
+                                           cooldown=0),
+                     interval_s=999.0, log=lambda *a, **k: None)
+    assert asc.tick_once() == +1          # below min: respawn
+    assert stub.ups == 1 and stub.healthy == 2
+    assert asc.tick_once() == 0           # hysteresis tick 1 (at min:
+    assert asc.tick_once() == 0           # under-mark but floor holds)
+    assert stub.downs == 0
+    assert asc.status()["spawns"] == 1
