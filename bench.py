@@ -167,15 +167,16 @@ def _classify_and_report(blob: str, detail: str) -> int:
 
 def _supervise() -> int:
     """Probe the accelerator, then run the measurement under a watchdog."""
-    # --sim-only / --chaos-only / --fleet-only / --analyze-only are
-    # host-side by construction (modeled network; injected host faults;
-    # in-process replica fleet; abstract tracing) — never touch the
-    # accelerator
+    # --sim-only / --chaos-only / --fleet-only / --analyze-only /
+    # --tracesim-only are host-side by construction (modeled network;
+    # injected host faults; in-process replica fleet; abstract tracing;
+    # trace-replay queueing) — never touch the accelerator
     force_cpu = ("--cpu" in sys.argv or "--sim-only" in sys.argv
                  or "--chaos-only" in sys.argv
                  or "--fleet-only" in sys.argv
                  or "--analyze-only" in sys.argv
-                 or "--coldstart-only" in sys.argv)
+                 or "--coldstart-only" in sys.argv
+                 or "--tracesim-only" in sys.argv)
     if not force_cpu:
         probe_cmd = [sys.executable, "-c",
                      "import jax; print('PLATFORM=' + jax.devices()[0].platform)"]
@@ -1496,6 +1497,107 @@ def measure_fleet() -> dict:
     }
 
 
+def measure_tracesim() -> dict:
+    """The ISSUE 15 acceptance bench: sim-vs-live agreement on one
+    trace × policy point. The SAME seeded flash-crowd trace (deep
+    overload: the flash offers ~2× the replica's capacity, every
+    request deadlined — admission control and queue sheds both fire)
+    runs through (a) a REAL single-replica fleet via the open-loop
+    replayer and (b) the discrete-event cost model over a calibrated
+    ``ServiceProfile`` (two-point slope/intercept + saturated-burst
+    aggregate). Gate: the model's p99 TTFT within [0.5×, 2×] of live
+    (or 0.3 s absolute) and shed rate within 0.15 absolute — the
+    agreement contract that makes ``servesim/sweep.py``'s policy
+    frontier trustworthy. Both arms ``status=measured``; host-side by
+    construction (CPU-forced like --chaos-only)."""
+    import tempfile
+
+    import numpy as np
+
+    from gym_tpu.models.nanogpt import GPT, GPTConfig
+    from gym_tpu.serve.engine import SamplingParams
+    from gym_tpu.serve.metrics import ServeMetrics
+    from gym_tpu.serve.router import build_fleet
+    from gym_tpu.servesim import (FleetCostModel, calibrate_router,
+                                  flash_crowd_trace, replay_router)
+
+    import jax
+
+    cfg = GPTConfig(block_size=128, vocab_size=48, n_layer=4, n_head=4,
+                    n_embd=128, dropout=0.0, bias=True)
+    params = GPT(cfg).init({"params": jax.random.PRNGKey(0)},
+                           np.zeros((1, 8), np.int64),
+                           train=False)["params"]
+    metrics = ServeMetrics(tempfile.mkdtemp(prefix="gym_tpu_tsim_"),
+                           engine_log_every=10)
+    router = build_fleet(params, cfg, replicas=1, num_slots=1,
+                         decode_chunk=1, metrics=metrics,
+                         log=lambda *a, **k: None).start()
+    # warm every prefill bucket the trace can hit (8/16/32) — a compile
+    # inside the replay would poison BOTH the live tail and the
+    # calibration the model is anchored to
+    for n in (8, 16, 32):
+        router.submit(np.arange(1, n + 1, dtype=np.int32) % 48,
+                      SamplingParams(max_new_tokens=8, seed=n)
+                      ).result(timeout=300)
+    profile = calibrate_router(router, 48, num_slots=1,
+                               saturate_burst=8)
+
+    trace = flash_crowd_trace(
+        duration_s=24, base_rps=1.5, flash_at_s=6, flash_mult=24,
+        flash_len_s=6, seed=5, prompt_lens=(8, 32), max_news=(24, 56),
+        deadline_s=1.5, deadline_frac=1.0)
+    live = replay_router(router, trace, vocab_size=48,
+                         time_scale=1.0)["report"]
+    router.close(drain_deadline_s=60)
+    metrics.close()
+
+    model = FleetCostModel(profile, initial_replicas=1,
+                           autoscale=False).run(trace).report()
+
+    # the stated tolerances (the ci_deploy gate):
+    p99_l, p99_m = live["ttft_p99_s"], model["ttft_p99_s"]
+    shed_l, shed_m = live["shed_rate"], model["shed_rate"]
+    ttft_ok = (p99_l is not None and p99_m is not None
+               and (abs(p99_m - p99_l) <= 0.3
+                    or 0.5 <= p99_m / p99_l <= 2.0))
+    shed_ok = abs(shed_m - shed_l) <= 0.15
+    agreement = {
+        "ok": bool(ttft_ok and shed_ok),
+        "ttft_ok": bool(ttft_ok),
+        "shed_ok": bool(shed_ok),
+        "tolerance": ("model p99 TTFT within [0.5x, 2x] of live or "
+                      "0.3s abs; shed rate within 0.15 abs"),
+        "p99_ttft_ratio": (round(p99_m / p99_l, 3)
+                           if p99_l and p99_m else None),
+        "shed_rate_delta": round(abs(shed_m - shed_l), 4),
+    }
+    assert agreement["ok"], {"agreement": agreement,
+                             "live": live, "model": model}
+    return {
+        "metric": "tracesim_live_p99_ttft_s",
+        "status": "measured",
+        "measured": True,
+        # the --compare headline: LIVE p99 TTFT under the overload
+        # trace (lower is better, like the coldstart metric)
+        "value": p99_l,
+        "unit": "s_p99_ttft_live_lower_is_better",
+        "workload": ("flash-crowd trace: 24s, base 1.5 rps, 24x flash "
+                     "for 6s, prompt [8,32), max_new [24,56), 1.5s "
+                     "deadline on all; 1 replica x 1 slot chunk 1, "
+                     "gpt 4L/128d block 128; open-loop replay vs "
+                     "cost model on the calibrated profile"),
+        "requests": live["requests"],
+        "profile": {
+            "tokens_per_s": round(profile.tokens_per_s, 1),
+            "request_overhead_s": round(profile.request_overhead_s, 5),
+        },
+        "live": live,
+        "model": model,
+        "agreement": agreement,
+    }
+
+
 def measure_analysis() -> dict:
     """Static-analysis summary (ISSUE 6): the full suite — lint, static
     trace reconciliation, jaxpr audit — as one JSON line, the
@@ -1524,7 +1626,8 @@ def main() -> None:
                  or "--chaos-only" in sys.argv
                  or "--fleet-only" in sys.argv
                  or "--analyze-only" in sys.argv
-                 or "--coldstart-only" in sys.argv)
+                 or "--coldstart-only" in sys.argv
+                 or "--tracesim-only" in sys.argv)
     if force_cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
 
@@ -1572,6 +1675,10 @@ def main() -> None:
 
     if "--fleet-only" in sys.argv:
         print(json.dumps({"fleet": measure_fleet()}))
+        return
+
+    if "--tracesim-only" in sys.argv:
+        print(json.dumps({"tracesim": measure_tracesim()}))
         return
 
     if "--analyze-only" in sys.argv:

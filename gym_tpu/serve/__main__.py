@@ -268,6 +268,7 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
                   autoscale: bool = False,
                   min_replicas: Optional[int] = None,
                   max_replicas: Optional[int] = None,
+                  autoscale_policy: Optional[Any] = None,
                   autoscale_interval_s: float = 1.0,
                   fleet_dir: Optional[str] = None,
                   worker_startup_timeout_s: float = 240.0,
@@ -368,10 +369,26 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
             lo = replicas if min_replicas is None else int(min_replicas)
             hi = (max(replicas, 4) if max_replicas is None
                   else int(max_replicas))
+            if autoscale_policy is not None:
+                # an explicit policy supplies the watermark/patience
+                # knobs; EXPLICIT replica-bound arguments still win (a
+                # caller asking for min_replicas=2 must never scale
+                # below 2 because the policy object defaulted to 1)
+                policy = dataclasses.replace(
+                    autoscale_policy,
+                    min_replicas=(int(min_replicas)
+                                  if min_replicas is not None
+                                  else autoscale_policy.min_replicas),
+                    max_replicas=(int(max_replicas)
+                                  if max_replicas is not None
+                                  else autoscale_policy.max_replicas))
+            else:
+                policy = AutoscalePolicy(min_replicas=lo,
+                                         max_replicas=hi)
             autoscaler = Autoscaler(
-                router,
-                AutoscalePolicy(min_replicas=lo, max_replicas=hi),
+                router, policy,
                 interval_s=autoscale_interval_s,
+                metrics=metrics,   # ISSUE 15: per-tick audit rows
                 log=lambda *a, **k: print(*a, file=sys.stderr,
                                           flush=True)).start()
         sched = sup = None

@@ -79,6 +79,10 @@ class AutoscaleController:
         self._under = 0         # consecutive ticks below the down mark
         self._cooldown = 0
         self.decisions = 0      # non-hold decisions issued (observable)
+        #: why the LAST tick decided what it decided — the audit-trail
+        #: string ``ServeMetrics.autoscale_tick`` persists per tick
+        #: (ISSUE 15); greppable prefixes: floor/cooldown/up/down/hold
+        self.last_reason = "init"
 
     def tick(self, healthy: int, starting: int, backlog_tokens: float,
              tokens_per_s: Optional[float]) -> int:
@@ -95,9 +99,12 @@ class AutoscaleController:
             self._over = self._under = 0
             self._cooldown = p.cooldown
             self.decisions += 1
+            self.last_reason = (f"floor: {total} < min_replicas "
+                                f"{p.min_replicas}")
             return +1
         if self._cooldown > 0:
             self._cooldown -= 1
+            self.last_reason = f"cooldown: {self._cooldown + 1} to go"
             return 0
         # price the backlog in seconds at the live aggregate rate; with
         # no rate yet (cold fleet), use the per-replica token watermark
@@ -105,24 +112,34 @@ class AutoscaleController:
             drain_s = backlog_tokens / tokens_per_s
             over = drain_s > p.up_drain_s
             under = drain_s < p.down_drain_s
+            gauge = f"drain_s={drain_s:.2f}"
         else:
             over = (healthy > 0
                     and backlog_tokens / max(1, healthy)
                     > p.up_backlog_tokens_per_replica)
             under = backlog_tokens == 0
+            gauge = (f"cold_backlog/replica="
+                     f"{backlog_tokens / max(1, healthy):.0f}")
         self._over = self._over + 1 if over else 0
         self._under = self._under + 1 if under else 0
         if (self._over >= p.up_patience and total < p.max_replicas):
             self._over = self._under = 0
             self._cooldown = p.cooldown
             self.decisions += 1
+            self.last_reason = (f"up: {gauge} over for "
+                                f"{p.up_patience} tick(s)")
             return +1
         if (self._under >= p.down_patience
                 and total > p.min_replicas and starting == 0):
             self._over = self._under = 0
             self._cooldown = p.cooldown
             self.decisions += 1
+            self.last_reason = (f"down: {gauge} under for "
+                                f"{p.down_patience} tick(s)")
             return -1
+        self.last_reason = (f"hold: {gauge} over={self._over}/"
+                            f"{p.up_patience} under={self._under}/"
+                            f"{p.down_patience}")
         return 0
 
 
@@ -134,10 +151,15 @@ class Autoscaler:
 
     def __init__(self, router: Any,
                  policy: Optional[AutoscalePolicy] = None,
-                 interval_s: float = 1.0, log=print):
+                 interval_s: float = 1.0, metrics: Any = None,
+                 log=print):
+        """``metrics``: a ``ServeMetrics`` — every tick is persisted as
+        an ``autoscale`` audit row (snapshot, decision, reason) so
+        decisions are reconstructible from ``serve.csv`` alone."""
         self.router = router
         self.controller = AutoscaleController(policy)
         self.interval_s = float(interval_s)
+        self.metrics = metrics
         self._log = log
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -166,6 +188,18 @@ class Autoscaler:
             int(snap.get("healthy", 0)), int(snap.get("starting", 0)),
             float(snap.get("backlog_tokens", 0.0)),
             snap.get("tokens_per_s"))
+        if self.metrics is not None:
+            try:
+                self.metrics.autoscale_tick(
+                    healthy=int(snap.get("healthy", 0)),
+                    starting=int(snap.get("starting", 0)),
+                    backlog_tokens=float(snap.get("backlog_tokens",
+                                                  0.0)),
+                    tokens_per_s=snap.get("tokens_per_s"),
+                    decision=decision,
+                    reason=self.controller.last_reason)
+            except Exception:  # noqa: BLE001 — observability must not
+                pass           # kill the control loop
         if decision > 0:
             rep = self.router.scale_up()
             self.spawns += 1

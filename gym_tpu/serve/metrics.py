@@ -88,6 +88,21 @@ HEADER = [
     # subprocess pid for process replicas. Absent in pre-fleet-process
     # CSVs; read_headline tolerates both (pinned, per repo convention).
     "pid",
+    # serving simulator (ISSUE 15): request rows carry the wall-clock
+    # offset (vs the collector's t0) at which the request was SUBMITTED
+    # — durations alone cannot reconstruct an arrival process, and the
+    # trace replayer (servesim/traces.py: replay_from_serve_csv) needs
+    # exact arrivals. Absent in pre-servesim CSVs; read_headline
+    # tolerates both.
+    "t_submit",
+    # autoscaler audit trail (ISSUE 15): ``kind=autoscale`` rows record
+    # every controller tick — the snapshot it priced (healthy/starting
+    # counts, backlog tokens; the rate rides the tokens_per_s column),
+    # the decision (status: up/down/hold) and the REASON string — so
+    # sim-vs-live validation and postmortems read decisions off disk
+    # instead of reverse-engineering them from replica counts. Absent
+    # in pre-servesim CSVs; read_headline tolerates both.
+    "as_healthy", "as_starting", "as_backlog_tokens", "as_reason",
 ]
 
 #: EWMA smoothing for the live tokens/s estimate (per driver tick with
@@ -306,6 +321,11 @@ class ServeMetrics:
         self.replicas_spawned = 0
         self.replicas_retired = 0
         self.streams_active = 0
+        # autoscaler audit trail (ISSUE 15): controller-tick counters
+        # next to the per-tick CSV rows
+        self.autoscale_ticks = 0
+        self.autoscale_ups = 0
+        self.autoscale_downs = 0
         self.tokens_out = 0
         self._ttft_sum = 0.0
         self._ttft_n = 0
@@ -430,6 +450,11 @@ class ServeMetrics:
                 self._lat_sum += lat
                 self._lat_n += 1
                 self._lats.append(lat)
+            # submit offset in the collector's clock: the arrival
+            # process, reconstructible from disk (ISSUE 15)
+            t_sub = getattr(req, "submit_t", None)
+            t_sub_cell = ("" if not t_sub
+                          else f"{t_sub - self._t0:.4f}")
             self._w.writerow([
                 f"{self._now():.4f}", "request", req.id, status,
                 queue_depth, active_slots,
@@ -439,6 +464,7 @@ class ServeMetrics:
                 self.tokens_out, f"{self.tokens_per_s():.2f}",
                 "", "", "", self._rid_cell(replica_id), "", "", "",
                 "", "", self._pid_cell(pid),
+                t_sub_cell, "", "", "", "",
             ])
             self._f.flush()
 
@@ -454,12 +480,15 @@ class ServeMetrics:
             rep = self._rep(replica_id)
             if rep is not None:
                 rep.rejected += 1
+            now = self._now()
             self._w.writerow([
-                f"{self._now():.4f}", "request", "", "rejected",
+                f"{now:.4f}", "request", "", "rejected",
                 queue_depth, active_slots, "", "", "", "",
                 self.tokens_out, f"{self.tokens_per_s():.2f}",
                 "", "", "", self._rid_cell(replica_id), "", "", "",
                 "", "", self._pid_cell(pid),
+                # an admission reject happens AT submit: arrival == now
+                f"{now:.4f}", "", "", "", "",
             ])
             self._f.flush()
 
@@ -479,7 +508,7 @@ class ServeMetrics:
                 f"{self.tokens_per_s():.2f}", "", "", "",
                 self._rid_cell(replica_id), *self._program_cells(),
                 self._weights_dtype or "", self._kv_dtype or "",
-                self._pid_cell(pid),
+                self._pid_cell(pid), "", "", "", "", "",
             ])
             self._f.flush()
 
@@ -500,7 +529,37 @@ class ServeMetrics:
                 f"{self.tokens_per_s():.2f}", "", "", "",
                 self._rid_cell(replica_id), *self._program_cells(),
                 self._weights_dtype or "", self._kv_dtype or "",
-                self._pid_cell(pid),
+                self._pid_cell(pid), "", "", "", "", "",
+            ])
+            self._f.flush()
+
+    def autoscale_tick(self, healthy: int, starting: int,
+                       backlog_tokens: float,
+                       tokens_per_s: Optional[float], decision: int,
+                       reason: str) -> None:
+        """Autoscaler audit trail (ISSUE 15): one ``kind=autoscale`` row
+        per controller tick — the exact snapshot the decision priced
+        plus the decision and its reason. ``status`` types the decision
+        (``up``/``down``/``hold``); the snapshot's aggregate rate rides
+        the ``tokens_per_s`` column. Sim-vs-live validation replays
+        these against the cost model's modeled ticks; postmortems stop
+        reverse-engineering decisions from replica counts."""
+        with self._lock:
+            if self._f.closed:
+                return
+            self.autoscale_ticks += 1
+            self.autoscale_ups += int(decision > 0)
+            self.autoscale_downs += int(decision < 0)
+            status = ("up" if decision > 0
+                      else "down" if decision < 0 else "hold")
+            self._w.writerow([
+                f"{self._now():.4f}", "autoscale", "", status, "", "",
+                "", "", "", "", self.tokens_out,
+                ("" if tokens_per_s is None
+                 else f"{tokens_per_s:.2f}"),
+                "", "", "", "", "", "", "", "", "", "",
+                "", int(healthy), int(starting),
+                f"{float(backlog_tokens):.1f}", str(reason),
             ])
             self._f.flush()
 
@@ -550,7 +609,7 @@ class ServeMetrics:
                 kv, ph, ("" if sr is None else f"{sr:.4f}"),
                 self._rid_cell(replica_id), *self._program_cells(),
                 self._weights_dtype or "", self._kv_dtype or "",
-                self._pid_cell(pid),
+                self._pid_cell(pid), "", "", "", "", "",
             ])
 
     def tokens_per_s(self) -> float:
@@ -625,6 +684,12 @@ class ServeMetrics:
                 "weights_dtype": self._weights_dtype,
                 "kv_dtype": self._kv_dtype,
             }
+            if self.autoscale_ticks:
+                head["autoscale"] = {
+                    "ticks": self.autoscale_ticks,
+                    "ups": self.autoscale_ups,
+                    "downs": self.autoscale_downs,
+                }
             progs = _program_counters()
             if progs is not None:
                 # the device-program registry's live counters (hits /
@@ -691,6 +756,7 @@ def read_headline(path: str) -> Dict[str, Any]:
     kv_dtype: Optional[str] = None
     programs: Optional[Dict[str, Any]] = None
     per_rep: Dict[str, Dict[str, int]] = {}
+    as_ticks = as_ups = as_downs = 0
 
     def rep_of(row):
         rid = row.get("replica_id")
@@ -736,6 +802,13 @@ def read_headline(path: str) -> Dict[str, Any]:
                             row["program_compile_s"] or 0.0),
                     }
                 continue
+            if row["kind"] == "autoscale":
+                # autoscaler audit rows (ISSUE 15; absent in
+                # pre-servesim CSVs — this branch simply never fires)
+                as_ticks += 1
+                as_ups += int(row["status"] == "up")
+                as_downs += int(row["status"] == "down")
+                continue
             if row["kind"] != "request":
                 continue
             status = row["status"]
@@ -777,6 +850,9 @@ def read_headline(path: str) -> Dict[str, Any]:
     }
     if programs is not None:
         head["programs"] = programs
+    if as_ticks:
+        head["autoscale"] = {"ticks": as_ticks, "ups": as_ups,
+                             "downs": as_downs}
     if per_rep:
         head["replicas"] = dict(sorted(per_rep.items()))
     head.update(_percentiles(ttfts, "ttft"))

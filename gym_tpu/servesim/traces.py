@@ -1,0 +1,370 @@
+"""Synthetic serving-workload traces (ISSUE 15).
+
+A trace is a seeded, reproducible list of ``RequestEvent``s — the
+arrival process plus each request's shape — with a stable on-disk CSV
+format, so the SAME trace drives both arms of the serving simulator:
+
+- ``replay.py`` fires it open-loop at true (scaled) timestamps against
+  a real fleet;
+- ``cost_model.py`` runs it through the discrete-event queueing twin in
+  milliseconds.
+
+Families (all nonhomogeneous-Poisson arrivals via thinning, so the
+rate shape is exact and the draw is one ``numpy`` Generator seeded from
+``seed`` — same seed, same trace, bit-for-bit):
+
+- ``diurnal``     — sinusoidal rate (the day/night cycle compressed to
+  ``duration_s``), starting at the trough.
+- ``bursty``      — 2-state MMPP (Markov-modulated Poisson): calm rate
+  / burst rate with exponential dwell times — the flappy-traffic shape
+  hysteresis and cooldown exist for.
+- ``flash_crowd`` — constant base rate with one step to
+  ``flash_mult ×`` for ``flash_len_s`` — the scale-up-latency probe.
+- ``replay:<serve.csv>`` — exact arrivals reconstructed from a live
+  run's ``t_submit`` column (the ISSUE 15 schema satellite; durations
+  alone cannot reconstruct an arrival process).
+
+``prefix_group`` marks requests that share a prompt prefix
+(``prompt_tokens`` materializes group members from one seeded stream,
+so shared-prefix traffic exercises the paged cache + prefix-affine
+dispatch); ``seed`` makes each request's sampling deterministic.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import dataclasses
+import json
+import math
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+#: stable on-disk column order (``save_trace``/``load_trace``); loading
+#: refuses a file whose header disagrees — a trace is an artifact, not
+#: a guess
+TRACE_HEADER = ["arrival_s", "prompt_len", "max_new", "deadline_s",
+                "prefix_group", "seed"]
+
+TRACE_FAMILIES = ("diurnal", "bursty", "flash_crowd")
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestEvent:
+    """One request in a trace: when it arrives and what it asks for."""
+
+    arrival_s: float
+    prompt_len: int
+    max_new: int
+    deadline_s: Optional[float] = None
+    #: requests with the same non-negative group share a prompt prefix
+    prefix_group: Optional[int] = None
+    #: per-request sampling seed (determinism across replay arms)
+    seed: int = 0
+
+
+def save_trace(path: str, events: List[RequestEvent]) -> str:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(TRACE_HEADER)
+        for e in events:
+            # repr floats: load_trace(save_trace(...)) is EXACT — a
+            # trace is an artifact both simulator arms must agree on
+            w.writerow([
+                repr(float(e.arrival_s)), e.prompt_len, e.max_new,
+                "" if e.deadline_s is None else repr(float(e.deadline_s)),
+                "" if e.prefix_group is None else e.prefix_group,
+                e.seed])
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def load_trace(path: str) -> List[RequestEvent]:
+    with open(path, newline="") as f:
+        r = csv.reader(f)
+        header = next(r, None)
+        if header != TRACE_HEADER:
+            raise ValueError(
+                f"{path} is not a gym_tpu trace (header {header!r}, "
+                f"want {TRACE_HEADER!r})")
+        events = []
+        for row in r:
+            events.append(RequestEvent(
+                arrival_s=float(row[0]), prompt_len=int(row[1]),
+                max_new=int(row[2]),
+                deadline_s=float(row[3]) if row[3] else None,
+                prefix_group=int(row[4]) if row[4] else None,
+                seed=int(row[5])))
+    return events
+
+
+# -- prompt materialization ------------------------------------------------
+
+
+def prompt_tokens(ev: RequestEvent, vocab_size: int,
+                  prefix_frac: float = 0.5) -> np.ndarray:
+    """The request's actual prompt, derived deterministically from the
+    event alone: members of one ``prefix_group`` share the leading
+    ``prefix_frac`` of their prompt (one seeded stream per group, so
+    any two members agree on their common prefix — the paged cache and
+    prefix-affine dispatch see real shared-prefix traffic); the tail
+    (and ungrouped prompts entirely) comes from the per-request
+    ``seed`` stream."""
+    plen = int(ev.prompt_len)
+    tail_rng = np.random.default_rng([4217, int(ev.seed), plen])
+    if ev.prefix_group is None or ev.prefix_group < 0:
+        return tail_rng.integers(0, vocab_size, plen).astype(np.int32)
+    npfx = max(1, int(plen * prefix_frac))
+    pfx_rng = np.random.default_rng([9173, int(ev.prefix_group)])
+    pfx = pfx_rng.integers(0, vocab_size, npfx)
+    tail = tail_rng.integers(0, vocab_size, plen - npfx)
+    return np.concatenate([pfx, tail]).astype(np.int32)
+
+
+# -- arrival processes -----------------------------------------------------
+
+
+def _thinned_poisson(rng: np.random.Generator,
+                     rate_fn: Callable[[float], float],
+                     duration_s: float, max_rate: float) -> List[float]:
+    """Nonhomogeneous Poisson arrivals on [0, duration) by thinning:
+    draw a homogeneous process at ``max_rate``, keep each point with
+    probability ``rate_fn(t) / max_rate``."""
+    if max_rate <= 0:
+        return []
+    t, out = 0.0, []
+    while True:
+        t += float(rng.exponential(1.0 / max_rate))
+        if t >= duration_s:
+            return out
+        if rng.random() < rate_fn(t) / max_rate:
+            out.append(t)
+
+
+def _shape_events(rng: np.random.Generator, arrivals: List[float], *,
+                  prompt_lens=(8, 48), max_news=(8, 32),
+                  deadline_s: Optional[float] = None,
+                  deadline_frac: float = 0.0,
+                  prefix_groups: int = 0,
+                  prefix_frac_of_requests: float = 0.5
+                  ) -> List[RequestEvent]:
+    """Attach request shapes to an arrival list. ``deadline_frac`` of
+    requests carry ``deadline_s``; ``prefix_frac_of_requests`` of them
+    are spread across ``prefix_groups`` shared-prefix groups."""
+    events = []
+    for i, t in enumerate(arrivals):
+        plen = int(rng.integers(prompt_lens[0], prompt_lens[1]))
+        mnew = int(rng.integers(max_news[0], max_news[1]))
+        dl = (float(deadline_s)
+              if deadline_s is not None and rng.random() < deadline_frac
+              else None)
+        grp = (int(rng.integers(0, prefix_groups))
+               if prefix_groups > 0
+               and rng.random() < prefix_frac_of_requests else None)
+        events.append(RequestEvent(
+            arrival_s=float(t), prompt_len=plen, max_new=mnew,
+            deadline_s=dl, prefix_group=grp, seed=i))
+    return events
+
+
+def diurnal_trace(duration_s: float = 60.0, base_rps: float = 2.0,
+                  amplitude: float = 0.8,
+                  period_s: Optional[float] = None, seed: int = 0,
+                  **shape_kw) -> List[RequestEvent]:
+    """Sinusoidal rate ``base·(1 + A·sin)``, one full period over
+    ``period_s`` (default: the whole trace), starting at the trough —
+    the compressed day/night cycle the scale-down half of a policy is
+    priced against."""
+    period = float(period_s or duration_s)
+    amplitude = min(max(float(amplitude), 0.0), 1.0)
+
+    def rate(t):
+        return base_rps * (1.0 + amplitude
+                           * math.sin(2 * math.pi * t / period
+                                      - math.pi / 2))
+
+    rng = np.random.default_rng([101, seed])
+    arr = _thinned_poisson(rng, rate, duration_s,
+                           base_rps * (1.0 + amplitude))
+    return _shape_events(rng, arr, **shape_kw)
+
+
+def bursty_trace(duration_s: float = 60.0, calm_rps: float = 0.5,
+                 burst_rps: float = 8.0, mean_calm_s: float = 8.0,
+                 mean_burst_s: float = 2.0, seed: int = 0,
+                 **shape_kw) -> List[RequestEvent]:
+    """2-state MMPP: exponential dwell in a calm state at ``calm_rps``
+    and a burst state at ``burst_rps`` — the flappy shape that punishes
+    a policy with no hysteresis/cooldown."""
+    rng = np.random.default_rng([202, seed])
+    edges: List[float] = []     # state-change times; starts calm
+    t = 0.0
+    burst = False
+    while t < duration_s:
+        dwell = float(rng.exponential(
+            mean_burst_s if burst else mean_calm_s))
+        t += dwell
+        edges.append(min(t, duration_s))
+        burst = not burst
+
+    def rate(t):
+        # state flips at each edge; even intervals (before edges[0],
+        # after edges[1], ...) are calm
+        import bisect
+        return burst_rps if bisect.bisect_right(edges, t) % 2 else calm_rps
+
+    arr = _thinned_poisson(rng, rate, duration_s,
+                           max(calm_rps, burst_rps))
+    return _shape_events(rng, arr, **shape_kw)
+
+
+def flash_crowd_trace(duration_s: float = 60.0, base_rps: float = 1.0,
+                      flash_at_s: float = 20.0,
+                      flash_mult: float = 8.0,
+                      flash_len_s: float = 10.0, seed: int = 0,
+                      **shape_kw) -> List[RequestEvent]:
+    """Constant base rate with one step to ``flash_mult × base_rps``
+    for ``flash_len_s`` — the scale-up-latency probe (how long does the
+    backlog take to drain after the policy reacts?)."""
+
+    def rate(t):
+        if flash_at_s <= t < flash_at_s + flash_len_s:
+            return base_rps * flash_mult
+        return base_rps
+
+    rng = np.random.default_rng([303, seed])
+    arr = _thinned_poisson(rng, rate, duration_s, base_rps * flash_mult)
+    return _shape_events(rng, arr, **shape_kw)
+
+
+def replay_from_serve_csv(path: str, default_max_new: int = 16,
+                          deadline_s: Optional[float] = None
+                          ) -> List[RequestEvent]:
+    """Reconstruct a trace from a live run's ``serve.csv`` — EXACT
+    arrivals via the ``t_submit`` column (request rows; the ISSUE 15
+    schema satellite), normalized so the first arrival is t=0. Rows
+    predating the column (or rejected rows with no token counts) fall
+    back to ``default_max_new``; deadlines are not recorded in
+    serve.csv, so ``deadline_s`` (if given) applies uniformly."""
+    rows = []
+    with open(path, newline="") as f:
+        for row in csv.DictReader(f):
+            if row.get("kind") != "request":
+                continue
+            t_sub = row.get("t_submit")
+            if not t_sub:
+                # pre-servesim CSV: fall back to the completion stamp —
+                # the best available anchor (documented inexact)
+                t_sub = row.get("ts_s")
+            if not t_sub:
+                continue
+            plen = int(float(row.get("prompt_tokens") or 0))
+            mnew = int(float(row.get("new_tokens") or 0))
+            rows.append((float(t_sub), max(1, plen),
+                         mnew if mnew > 0 else int(default_max_new)))
+    if not rows:
+        raise ValueError(f"{path} holds no replayable request rows")
+    rows.sort()
+    t0 = rows[0][0]
+    return [RequestEvent(arrival_s=t - t0, prompt_len=p, max_new=m,
+                         deadline_s=deadline_s, prefix_group=None,
+                         seed=i)
+            for i, (t, p, m) in enumerate(rows)]
+
+
+def make_trace(family: str, seed: int = 0,
+               **kw: Any) -> List[RequestEvent]:
+    """Family-name dispatch (the sweep's and CLI's entry point).
+    ``replay:<path>`` replays a ``serve.csv``."""
+    if family.startswith("replay:"):
+        return replay_from_serve_csv(family[len("replay:"):], **kw)
+    fns = {"diurnal": diurnal_trace, "bursty": bursty_trace,
+           "flash_crowd": flash_crowd_trace}
+    if family not in fns:
+        raise ValueError(f"unknown trace family {family!r}; known: "
+                         f"{TRACE_FAMILIES} or replay:<serve.csv>")
+    return fns[family](seed=seed, **kw)
+
+
+def trace_stats(events: List[RequestEvent]) -> Dict[str, Any]:
+    """Headline shape of a trace (sanity surface for reports/CLI)."""
+    if not events:
+        return {"requests": 0}
+    arr = np.asarray([e.arrival_s for e in events])
+    dur = float(arr.max()) if arr.size else 0.0
+    bins = np.bincount(arr.astype(int),
+                       minlength=int(dur) + 1) if dur else np.array([0])
+    return {
+        "requests": len(events),
+        "duration_s": round(dur, 3),
+        "mean_rps": round(len(events) / dur, 3) if dur else None,
+        "peak_rps_1s": int(bins.max()),
+        "total_max_new": int(sum(e.max_new for e in events)),
+        "with_deadline": sum(1 for e in events
+                             if e.deadline_s is not None),
+        "prefix_grouped": sum(1 for e in events
+                              if e.prefix_group is not None),
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="Generate a seeded synthetic serving trace "
+                    "(diurnal / bursty / flash_crowd, or "
+                    "replay:<serve.csv>) in the stable on-disk format")
+    p.add_argument("--family", default="diurnal",
+                   help=f"one of {TRACE_FAMILIES} or replay:<serve.csv>")
+    p.add_argument("--duration", type=float, default=60.0)
+    p.add_argument("--rps", type=float, default=2.0,
+                   help="base requests/s (burst family: calm rate)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--deadline", type=float, default=None,
+                   help="deadline_s applied to --deadline-frac of "
+                        "requests")
+    p.add_argument("--deadline-frac", type=float, default=1.0)
+    p.add_argument("--prefix-groups", type=int, default=0)
+    p.add_argument("--prompt-lens", default="8-48", metavar="LO-HI",
+                   help="prompt-length range (prompt + max_new must "
+                        "fit the served model's block_size)")
+    p.add_argument("--max-new", default="8-32", metavar="LO-HI",
+                   help="max_new_tokens range")
+    p.add_argument("--out", required=True, metavar="TRACE_CSV")
+    args = p.parse_args(argv)
+
+    def _range(s: str):
+        lo, hi = s.split("-")
+        return (int(lo), int(hi))
+
+    if args.family.startswith("replay:"):
+        # a replayed serve.csv fixes the arrivals and shapes; only the
+        # knobs replay_from_serve_csv understands apply (everything
+        # else would be silently ignored — refuse the footgun instead)
+        kw: Dict[str, Any] = dict(
+            deadline_s=args.deadline,
+            default_max_new=_range(args.max_new)[1])
+    else:
+        kw = dict(duration_s=args.duration,
+                  deadline_s=args.deadline,
+                  deadline_frac=args.deadline_frac,
+                  prefix_groups=args.prefix_groups,
+                  prompt_lens=_range(args.prompt_lens),
+                  max_news=_range(args.max_new))
+        if args.family == "bursty":
+            kw["calm_rps"] = args.rps
+        else:
+            kw["base_rps"] = args.rps
+    events = make_trace(args.family, seed=args.seed, **kw)
+    save_trace(args.out, events)
+    print(json.dumps({"trace": args.out, "family": args.family,
+                      **trace_stats(events)}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

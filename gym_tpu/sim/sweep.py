@@ -38,6 +38,8 @@ import math
 import os
 from typing import Any, Dict, List, Optional
 
+from . import gridlib
+
 # strategies that take a sync-interval H
 _H_STRATEGIES = ("diloco", "fedavg", "diloco_sparta", "noloco",
                  "demo_outer")
@@ -218,13 +220,10 @@ def _workload(cfg: SweepConfig, nodes: int):
     return GPT(cfg_m), ds
 
 
-def _atomic_json(path: str, payload: Dict[str, Any]) -> None:
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(payload, f, indent=2, default=str)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+# shared resumable-grid machinery (extracted to gridlib so the serving
+# sweep — servesim/sweep.py — reuses the exact same cell protocol)
+_atomic_json = gridlib.atomic_json
+_write_csv = gridlib.write_csv
 
 
 def _recover_compute_estimate(run_dir: str, ns) -> Optional[float]:
@@ -350,20 +349,6 @@ def run_cell(cell: Cell, cfg: SweepConfig) -> Dict[str, Any]:
         # divergence between the jitted accounting and the host trace
         "reconciled": rel_err <= 1e-5,
     }
-
-
-def _write_csv(path: str, rows: List[Dict[str, Any]]) -> None:
-    if not rows:
-        return
-    # union of keys, first-row order first: cells cached by an older
-    # sweep build may lack newer columns (e.g. `bits`)
-    cols = list(rows[0].keys())
-    for r in rows[1:]:
-        cols.extend(k for k in r.keys() if k not in cols)
-    with open(path, "w", newline="") as f:
-        w = csv.DictWriter(f, fieldnames=cols, restval="")
-        w.writeheader()
-        w.writerows(rows)
 
 
 def _baseline_of(rows: List[Dict[str, Any]], row) -> Optional[Dict]:
@@ -540,48 +525,25 @@ def _invalidate_if_stale(out: str, sig: Dict[str, Any]) -> bool:
     30-step cache — and a half-trained checkpoint from the old workload
     must not seed the new fits). The XLA compile cache stays: it is
     keyed by program hash. Returns True when state was wiped."""
-    import shutil
-    marker = os.path.join(out, "workload.json")
-    stale = False
-    if os.path.exists(marker):
-        try:
-            with open(marker) as f:
-                stale = json.load(f) != sig
-        except (OSError, ValueError):
-            stale = True
-    if stale:
-        print("workload config changed — discarding cached cells, "
-              "checkpoints, and logs under", out)
-        for sub in ("cells", "ckpt", "logs"):
-            shutil.rmtree(os.path.join(out, sub), ignore_errors=True)
-    os.makedirs(out, exist_ok=True)
-    _atomic_json(marker, sig)
-    return stale
+    return gridlib.invalidate_if_stale(out, sig,
+                                       state_dirs=("cells", "ckpt",
+                                                   "logs"))
 
 
 def run_sweep(cfg: SweepConfig) -> List[Dict[str, Any]]:
     _invalidate_if_stale(cfg.out, _workload_sig(cfg))
-    cells_dir = os.path.join(cfg.out, "cells")
-    os.makedirs(cells_dir, exist_ok=True)
     cells = grid(cfg)
-    rows: List[Dict[str, Any]] = []
-    for i, cell in enumerate(cells):
-        cell_path = os.path.join(cells_dir, cell.cell_id + ".json")
-        if os.path.exists(cell_path):
-            # finished in a previous (possibly killed) invocation
-            with open(cell_path) as f:
-                rows.append(json.load(f))
-            print(f"[{i + 1}/{len(cells)}] {cell.cell_id}: cached")
-            continue
-        print(f"[{i + 1}/{len(cells)}] {cell.cell_id}: running ...",
-              flush=True)
-        row = run_cell(cell, cfg)
-        _atomic_json(cell_path, row)
-        rows.append(row)
+
+    def _run_one(i: int) -> Dict[str, Any]:
+        row = run_cell(cells[i], cfg)
         print(f"    sim_total_s={row['sim_total_s']:.3f} "
               f"comm={row['cum_comm_bytes'] / 1e6:.2f}MB "
               f"loss={row['final_train_loss']:.4f} "
               f"reconciled={row['reconciled']}")
+        return row
+
+    rows = gridlib.run_cells(cfg.out, [c.cell_id for c in cells],
+                             _run_one)
     _write_csv(os.path.join(cfg.out, "results.csv"), rows)
     write_frontier_csv(os.path.join(cfg.out, "frontier.csv"), rows)
     _atomic_json(os.path.join(cfg.out, "results.json"),

@@ -1,0 +1,85 @@
+#!/usr/bin/env bash
+# Continuous-deployment gate (ISSUE 15) — the seventh CI gate, run NEXT
+# TO ci_tier1 / ci_faults / ci_sim / ci_serve / ci_chaos / ci_analyze:
+#
+# 1. the servesim unit suite (trace determinism, cost-model policy
+#    invariants, replay, serve.csv schema satellites);
+# 2. the serving-policy FRONTIER regression gate against the committed
+#    baseline (logs/servesim/frontier_baseline.json) — deterministic
+#    cost-model path, seconds;
+# 3. the CLOSED TRAIN->DEPLOY LOOP drill: a live trainer (SIGKILLed
+#    mid-run and resumed — the PR-2 kill harness) streams checkpoints
+#    into a reload-watching OUT-OF-PROCESS fleet while a trace replays
+#    open-loop. Gates: zero dropped requests, zero recompiles across
+#    every hot-swap (per-worker program counters), post-swap streams
+#    byte-exact vs generate_fast;
+# 4. the tracesim bench (`bench.py --tracesim-only`): sim-vs-live
+#    agreement on one trace x policy point, both arms measured.
+#
+# CPU-only; sized for the 2-core container.
+#
+# Usage: scripts/ci_deploy.sh   (from the repo root or anywhere)
+set -o pipefail
+cd "$(dirname "$0")/.."
+REPO="$(pwd)"
+
+rm -f /tmp/_deploy.log
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_servesim.py -q -m 'not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_deploy.log
+rc=${PIPESTATUS[0]}
+echo DEPLOY_DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' \
+    /tmp/_deploy.log | tr -cd . | wc -c)
+[ "$rc" -ne 0 ] && exit "$rc"
+
+# policy-frontier regression gate (deterministic cost-model path)
+timeout -k 10 300 env JAX_PLATFORMS=cpu PYTHONPATH="$REPO" \
+    python -m gym_tpu.servesim.frontier_gate \
+    --baseline logs/servesim/frontier_baseline.json || {
+    echo "ci_deploy: serving frontier regression"; exit 1; }
+
+# the closed train->deploy loop: trainer (killed + resumed) ->
+# --reload-watch process fleet -> open-loop trace replay; the drill
+# asserts zero dropped / zero recompiles / post-swap streams exact and
+# exits nonzero otherwise
+OUT=${GYM_TPU_CI_DEPLOY_OUT:-/tmp/gym_tpu_ci_deploy}
+rm -rf "$OUT"; mkdir -p "$OUT"
+timeout -k 10 900 env JAX_PLATFORMS=cpu PYTHONPATH="$REPO" \
+    python -m gym_tpu.servesim.drill --out "$OUT/drill" \
+    --replicas 2 --out-of-process --kill-trainer \
+    2>&1 | tee "$OUT/drill.log" | grep -v '"POST /generate'
+rc=${PIPESTATUS[0]}
+[ "$rc" -ne 0 ] && { echo "ci_deploy: closed-loop drill failed";
+    tail -40 "$OUT/drill.log"; exit "$rc"; }
+grep -q '"ok": true' "$OUT/drill.log" || {
+    echo "ci_deploy: drill reported not-ok"; tail -40 "$OUT/drill.log";
+    exit 1; }
+pgrep -f "gym_tpu.serve.worker" > /dev/null && {
+    echo "ci_deploy: leaked worker processes:";
+    pgrep -af "gym_tpu.serve.worker"; exit 1; }
+
+# tracesim bench: the sim-vs-live agreement contract, one JSON line
+timeout -k 10 900 env JAX_PLATFORMS=cpu PYTHONPATH="$REPO" \
+    python "$REPO/bench.py" --tracesim-only > "$OUT/tracesim.json" || {
+    echo "ci_deploy: tracesim bench failed"; cat "$OUT/tracesim.json";
+    exit 1; }
+python - "$OUT/tracesim.json" <<'EOF'
+import json, sys
+with open(sys.argv[1]) as f:
+    line = f.read().strip().splitlines()[-1]
+ts = json.loads(line)["tracesim"]
+assert ts["status"] == "measured", ts.get("status")
+assert ts["agreement"]["ok"], ts["agreement"]
+print("ci_deploy: tracesim agreement —",
+      "p99 ttft live", ts["live"]["ttft_p99_s"],
+      "model", ts["model"]["ttft_p99_s"],
+      "| shed live", ts["live"]["shed_rate"],
+      "model", ts["model"]["shed_rate"])
+EOF
+rc=$?
+[ "$rc" -ne 0 ] && { echo "ci_deploy: tracesim agreement failed";
+    cat "$OUT/tracesim.json"; exit "$rc"; }
+
+echo "ci_deploy: OK"
+exit 0
