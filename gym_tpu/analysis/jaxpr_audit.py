@@ -384,11 +384,26 @@ def paged_program_specs(num_slots: int = 2, decode_chunk: int = 4,
                                         gamma=gamma)]
 
 
+def elastic_program_specs() -> List[ProgramSpec]:
+    """The elastic-membership redistribution family (ROADMAP: Elastic
+    ZeRO) at its audit parameterization — flat ZeRO-slice re-partition,
+    replicated-row re-replication, and the sharded-params unshard, each
+    across uneven K→K' pairs. Enumerated through the SAME public defs
+    the trainer's resume path acquires (``programs.elastic_defs``), so
+    reshard keys cannot drift from what restore actually builds. The
+    family takes host arrays from a checkpoint — nothing to donate —
+    and must stay callback-free and f64-clean like every other shipped
+    program."""
+    from ..programs.elastic_defs import elastic_program_defs
+    return [_spec_from_def(d) for d in elastic_program_defs()]
+
+
 def shipped_programs(num_nodes: int = 4) -> List[ProgramSpec]:
     """Every compiled program the repo ships, audit-sized (tiny model:
     the checks are structural — donation masks, callback freedom, dtype
     discipline — and shape-independent)."""
-    return trainer_step_specs(num_nodes) + engine_program_specs()
+    return (trainer_step_specs(num_nodes) + engine_program_specs()
+            + elastic_program_specs())
 
 
 def recompile_guard(audits: Sequence[ProgramAudit]) -> Dict[str, Any]:
@@ -445,13 +460,16 @@ def registry_key_reconciliation(audits: Sequence[ProgramAudit]
     drifted apart — exactly the bespoke-cache split the unified registry
     exists to prevent."""
     from ..programs import ProgramRegistry
+    from ..programs.elastic_defs import elastic_program_defs
 
     reg = ProgramRegistry()
     for d in engine_program_defs():
         reg.register(d)
+    for d in elastic_program_defs():
+        reg.register(d)
     registry_keys = set(reg.keys())
     audit_keys = {a.key_hash for a in audits
-                  if a.name.startswith("serve.")}
+                  if a.name.startswith(("serve.", "elastic."))}
     return {
         "n_registry_keys": len(registry_keys),
         "n_audit_serve_keys": len(audit_keys),

@@ -1,0 +1,22 @@
+"""gym_tpu.elastic — elastic membership for training (ROADMAP: Elastic
+ZeRO).
+
+Resume-at-any-node-count: ``reshard`` maps any checkpointed (K, layout)
+onto any live (K', layout') with registry-keyed collective
+redistribution programs, and owns the ZeRO-2 sharded checkpoint codec;
+``controller`` drives the training node set with the serving fleet's
+``AutoscaleController``.
+"""
+
+from .controller import ElasticTrainController, elastic_fit
+from .reshard import (STACKED_LAYOUT, ZERO2_LAYOUT, cold_restart_events,
+                      elastic_meta, make_zero2_codec, param_leaf_specs,
+                      reshard_events, reshard_state, saved_state_template)
+
+__all__ = [
+    "ZERO2_LAYOUT", "STACKED_LAYOUT",
+    "elastic_meta", "param_leaf_specs", "make_zero2_codec",
+    "saved_state_template",
+    "reshard_state", "reshard_events", "cold_restart_events",
+    "ElasticTrainController", "elastic_fit",
+]

@@ -8,6 +8,8 @@ program definitions, ``warmup`` for background AOT precompilation, and
 ``keys`` for the canonical program key shared with the jaxpr auditor.
 """
 
+from .elastic_defs import (elastic_program_defs, replicate_rows_def,
+                           reshard_flat_def, unshard_params_def)
 from .keys import program_key
 from .registry import (DEFAULT_CACHE_DIR, Program, ProgramDef,
                        ProgramRegistry, compile_counter,
@@ -20,4 +22,6 @@ __all__ = [
     "default_registry", "compile_counter", "xla_compile_counter",
     "enable_disk_tier", "disk_event_counters", "DEFAULT_CACHE_DIR",
     "WarmupThread", "warm_engine_programs",
+    "elastic_program_defs", "reshard_flat_def", "replicate_rows_def",
+    "unshard_params_def",
 ]

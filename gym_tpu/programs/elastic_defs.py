@@ -1,0 +1,139 @@
+"""Collective redistribution programs for elastic membership changes.
+
+Resharding a checkpointed ZeRO layout from K nodes onto K' is a handful
+of small device programs over the flat parameter/moment vectors (the
+arXiv 2112.01075 shape: redistribution as ONE compiled program, not a
+host gather/scatter round-trip).  They are defined here as
+``ProgramDef``s so ``gym_tpu.elastic`` acquires them through the shared
+program registry — built once per (K→K', shapes) signature under a
+canonical key, warm on every later resume at the same membership, and
+enumerable by the jaxpr audit (``analysis/jaxpr_audit.py``) like every
+other shipped program.
+
+All defs use ``donate_args=()``: a reshard's input ([K, s]) and output
+([K', s']) avals differ whenever the membership actually changes, so
+donation could never alias, and an empty donation mask is trivially
+clean under the audit's donation checks.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import ProgramDef
+
+
+def elastic_shard_size(n: int, k: int) -> int:
+    """ceil(n / k) — must match ``strategy.sharding.shard_size`` (which
+    takes a pytree; this one takes the already-flattened length)."""
+    return -(-n // k)
+
+
+def reshard_flat_def(n: int, k_from: int, k_to: int,
+                     dtype: Any = jnp.float32) -> ProgramDef:
+    """[k_from, ceil(n/k_from)] flat shards → [k_to, ceil(n/k_to)]:
+    drop the old pad tail, re-pad with zeros for the new shard size.
+    One def covers every flat vector of the same (n, K→K') signature —
+    params, Adam mu and nu all reuse the same executable."""
+    s_from = elastic_shard_size(n, k_from)
+    s_to = elastic_shard_size(n, k_to)
+    dt = jnp.dtype(dtype)
+
+    def _build():
+        def fn(shards):
+            flat = shards.reshape(-1)[:n]
+            return jnp.pad(flat, (0, k_to * s_to - n)).reshape(k_to, s_to)
+        return jax.jit(fn)
+
+    return ProgramDef(
+        name=f"elastic.reshard_flat[{k_from}->{k_to}]",
+        family="elastic.reshard",
+        config={"n": n, "k_to": k_to},
+        args=(jax.ShapeDtypeStruct((k_from, s_from), dt),),
+        donate_args=(),
+        builder=_build,
+    )
+
+
+def replicate_rows_def(shape: Tuple[int, ...], k_from: int, k_to: int,
+                       dtype: Any = jnp.float32) -> ProgramDef:
+    """[k_from, *shape] node-replicated state → [k_to, *shape]: row 0
+    repeated onto the new membership (rows are equal by construction —
+    the caller, ``gym_tpu.elastic``, verifies that before dispatch)."""
+    dt = jnp.dtype(dtype)
+
+    def _build():
+        def fn(x):
+            return jnp.repeat(x[:1], k_to, axis=0)
+        return jax.jit(fn)
+
+    return ProgramDef(
+        name=f"elastic.replicate_rows[{k_from}->{k_to}]",
+        family="elastic.reshard",
+        config={"k_to": k_to},
+        args=(jax.ShapeDtypeStruct((k_from,) + tuple(shape), dt),),
+        donate_args=(),
+        builder=_build,
+    )
+
+
+def unshard_params_def(leaf_specs: Sequence[Tuple[Tuple[int, ...], Any]],
+                       treedef, n: int, k_from: int,
+                       k_to: int) -> ProgramDef:
+    """ZeRO-2 param shards [k_from, ceil(n/k_from)] (f32) → the live
+    stacked parameter tree ([k_to, *leaf_shape] per leaf, leaf dtypes
+    restored).  ``leaf_specs`` is ``[(per_node_shape, dtype), ...]`` in
+    tree-leaf order — the SAME order ``ravel_pytree`` flattens, which is
+    how the shards were packed, so offsets line up exactly."""
+    s_from = elastic_shard_size(n, k_from)
+    specs = [(tuple(shape), jnp.dtype(dt)) for shape, dt in leaf_specs]
+    sig = ";".join(f"{shape}:{dt}" for shape, dt in specs)
+
+    def _build():
+        def fn(shards):
+            flat = shards.reshape(-1)[:n]
+            out, off = [], 0
+            for shape, dt in specs:
+                sz = int(math.prod(shape)) if shape else 1
+                leaf = flat[off:off + sz].reshape((1,) + shape).astype(dt)
+                out.append(jnp.repeat(leaf, k_to, axis=0))
+                off += sz
+            return jax.tree.unflatten(treedef, out)
+        return jax.jit(fn)
+
+    return ProgramDef(
+        name=f"elastic.unshard_params[{k_from}->{k_to}]",
+        family="elastic.reshard",
+        config={"n": n, "k_to": k_to, "tree": sig},
+        args=(jax.ShapeDtypeStruct((k_from, s_from), jnp.float32),),
+        donate_args=(),
+        builder=_build,
+    )
+
+
+def elastic_program_defs() -> List[ProgramDef]:
+    """The audit-facing elastic program set: fixed small signatures
+    covering the reshard families (uneven K' in both directions, a grow
+    and a shrink of the replicate path, and a ZeRO-2 param unshard).
+    ``analysis.jaxpr_audit`` turns these into ProgramSpecs and the
+    registry reconciliation registers exactly this set."""
+    tiny_tree = {"b": np.zeros((5,), np.float32),
+                 "w": np.zeros((3, 2), np.float32)}
+    leaves, treedef = jax.tree.flatten(
+        jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                     tiny_tree))
+    specs = [(l.shape, l.dtype) for l in leaves]
+    n = sum(int(math.prod(l.shape)) for l in leaves)  # 11: uneven for all K
+    return [
+        reshard_flat_def(n, 4, 3),
+        reshard_flat_def(n, 3, 4),
+        reshard_flat_def(n, 2, 3),
+        replicate_rows_def((), 4, 3, jnp.int32),
+        replicate_rows_def((5,), 3, 4),
+        unshard_params_def(specs, treedef, n, 4, 3),
+    ]

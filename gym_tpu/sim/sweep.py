@@ -36,7 +36,8 @@ import dataclasses
 import json
 import math
 import os
-from typing import Any, Dict, List, Optional
+import re
+from typing import Any, Dict, List, Optional, Tuple
 
 from . import gridlib
 
@@ -63,6 +64,19 @@ _ALIAS_PINNED_CODEC = {"dynamiq_int8": "int8", "dynamiq_int4": "int4"}
 STRATEGIES = ("simple_reduce", "zero_reduce", "diloco", "fedavg",
               "sparta", "diloco_sparta", "demo", "noloco", "dynamiq",
               "demo_outer")
+# membership events (ROADMAP: Elastic ZeRO): "join@k" / "leave@k" split
+# the cell into a K-node fit to step k and an elastic resume at K±1 for
+# the rest — the membership change itself is priced with the reshard
+# collective events on the cell's topology preset
+_EVENT_RE = re.compile(r"^(join|leave)@(\d+)$")
+
+
+def parse_event(event: str) -> Tuple[str, int]:
+    m = _EVENT_RE.match(event)
+    if not m:
+        raise ValueError(f"unknown membership event {event!r}; known: "
+                         f"none, join@<step>, leave@<step>")
+    return m.group(1), int(m.group(2))
 
 
 @dataclasses.dataclass
@@ -73,6 +87,7 @@ class SweepConfig:
     H: List[int]
     bits: List[int] = dataclasses.field(default_factory=lambda: [8])
     codecs: List[str] = dataclasses.field(default_factory=lambda: ["dense"])
+    events: List[str] = dataclasses.field(default_factory=lambda: ["none"])
     topk_frac: float = 0.05
     steps: int = 30
     batch_size: int = 8
@@ -105,6 +120,14 @@ class SweepConfig:
                                  f"known: {_KNOWN_CODECS}")
         if self.checkpoint_interval <= 0:
             self.checkpoint_interval = max(2, self.steps // 3)
+        for e in self.events:
+            if e == "none":
+                continue
+            _, k = parse_event(e)
+            if not 0 < k < self.steps:
+                raise ValueError(
+                    f"membership event {e!r} must land strictly inside "
+                    f"the run (0 < step < {self.steps})")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -114,12 +137,14 @@ class Cell:
     nodes: int
     preset: str
     codec: Optional[str] = None   # None = dense / codec-free strategy
+    event: Optional[str] = None   # None = static membership
 
     @property
     def cell_id(self) -> str:
         h = f"_H{self.H}" if self.H is not None else ""
         c = f"_{self.codec}" if self.codec is not None else ""
-        return f"{self.strategy}{h}{c}_n{self.nodes}_{self.preset}"
+        e = f"_{self.event}" if self.event is not None else ""
+        return f"{self.strategy}{h}{c}_n{self.nodes}_{self.preset}{e}"
 
     @property
     def bits(self) -> Optional[int]:
@@ -155,10 +180,16 @@ def grid(cfg: SweepConfig) -> List[Cell]:
                     cs = [None]
                 for h in hs:
                     for c in cs:
-                        cell = Cell(s, h, n, preset, c)
-                        if cell.cell_id not in seen:
-                            seen.add(cell.cell_id)
-                            cells.append(cell)
+                        for ev in cfg.events:
+                            event = None if ev == "none" else ev
+                            if (event is not None
+                                    and parse_event(event)[0] == "leave"
+                                    and n <= 1):
+                                continue   # nothing left to leave
+                            cell = Cell(s, h, n, preset, c, event)
+                            if cell.cell_id not in seen:
+                                seen.add(cell.cell_id)
+                                cells.append(cell)
     return cells
 
 
@@ -268,9 +299,129 @@ def _last_csv_loss(run_dir: str) -> Optional[float]:
         return None
 
 
+def _run_event_cell(cell: Cell, cfg: SweepConfig) -> Dict[str, Any]:
+    """A membership-event cell: a real K-node fit to the event step, an
+    ELASTIC resume at K±1 for the rest (the checkpoint + reshard path —
+    the same machinery a production join/leave would exercise), and the
+    membership change itself priced as reshard collectives on the cell's
+    topology. The cold-restart alternative (full state re-broadcast plus
+    the steps a mid-interval preemption recomputes) is priced alongside
+    for the reshard-vs-cold-restart verdict."""
+    import jax
+
+    from .. import Trainer
+    from ..elastic import cold_restart_events, reshard_events
+    from .cost_model import events_time, events_tx_bytes
+    from .simulator import NetworkSimulator
+    from .topology import resolve_topology
+
+    kind, k = parse_event(cell.event)
+    n1 = cell.nodes
+    n2 = n1 + 1 if kind == "join" else n1 - 1
+    model, ds = _workload(cfg, max(n1, n2))
+    run_dir = os.path.join(cfg.out, "logs", cell.cell_id)
+    common = dict(
+        batch_size=cfg.batch_size, minibatch_size=cfg.batch_size,
+        val_size=0, val_interval=0, seed=cfg.seed, show_progress=False,
+        network=cell.preset, network_overlap=cfg.overlap,
+        run_name=cell.cell_id, log_dir=os.path.join(cfg.out, "logs"),
+        save_dir=os.path.join(cfg.out, "ckpt", cell.cell_id),
+        checkpoint_interval=cfg.checkpoint_interval, resume="auto",
+        compilation_cache_dir=os.path.join(cfg.out, "xla_cache"),
+    )
+
+    def _seg(num_nodes, max_steps):
+        strategy = make_strategy(cell.strategy, cell.H, cfg.lr,
+                                 cell.codec, cfg.topk_frac)
+        res = Trainer(model, ds).fit(strategy=strategy,
+                                     num_nodes=num_nodes,
+                                     max_steps=max_steps, **common)
+        if res.preempted:
+            raise KeyboardInterrupt(
+                f"sweep cell {cell.cell_id} preempted mid-fit")
+        return strategy, res
+
+    strat1, res1 = _seg(n1, k)
+    strat2, res2 = _seg(n2, cfg.steps)
+
+    # compose the simulated clock per segment at each segment's real
+    # membership (each fit's own sim_summary re-prices its FULL step
+    # range at one K — wrong on both sides of the event)
+    ns1 = NetworkSimulator(strat1, res1.params, n1, cell.preset,
+                           overlap=cfg.overlap)
+    ns2 = NetworkSimulator(strat2, res2.params, n2, cell.preset,
+                           overlap=cfg.overlap)
+    c1 = float((res1.sim or {}).get("compute_s_per_step") or 0.0)
+    c2 = float((res2.sim or {}).get("compute_s_per_step") or 0.0)
+    if not c1 or not c2:
+        # zero-step resume of a finished segment: rebuild from the
+        # surviving per-row sim clock, or borrow the other segment's
+        rec = _recover_compute_estimate(run_dir, ns2)
+        c1 = c1 or rec or c2
+        c2 = c2 or rec or c1
+    sim1 = ns1.simulate(k, c1)
+    sim2 = ns2.simulate(cfg.steps, c2, start_step=k)
+
+    # the membership change itself: reshard vs cold restart, priced on
+    # this cell's topology at the larger membership
+    n_params = sum(int(math.prod(x.shape))
+                   for x in jax.tree.leaves(res2.params))
+    topo = resolve_topology(cell.preset, max(n1, n2))
+    rev = reshard_events(n_params, n1, n2)
+    reshard_s = events_time(rev, topo)
+    lost_steps = k % cfg.checkpoint_interval
+    cold_s = (events_time(cold_restart_events(n_params, n2), topo)
+              + lost_steps * c2)
+
+    with open(os.path.join(run_dir, "summary.json")) as f:
+        summary = json.load(f)
+    final_loss = float(summary.get("final_train_loss",
+                                   res2.final_train_loss))
+    if not math.isfinite(final_loss):
+        final_loss = _last_csv_loss(run_dir) or final_loss
+    # the stitched cum_comm_bytes column spans BOTH memberships; the
+    # trace reconciles segment-wise (reshard bytes move at restore time,
+    # outside the step loop, and are reported separately)
+    cum = float(summary.get("cum_comm_bytes", 0.0))
+    trace = (ns1.trace_tx_bytes(k)
+             + ns2.trace_tx_bytes(cfg.steps, start_step=k))
+    denom = max(abs(cum), abs(trace), 1.0)
+    rel_err = abs(cum - trace) / denom
+    return {
+        "cell": cell.cell_id,
+        "strategy": cell.strategy,
+        "H": cell.H,
+        "codec": cell.codec,
+        "bits": cell.bits,
+        "nodes": cell.nodes,
+        "topology": cell.preset,
+        "event": cell.event,
+        "nodes_after": n2,
+        "steps": res2.steps,
+        "final_train_loss": final_loss,
+        "measured_it_s": float(summary.get("steps_per_second",
+                                           res2.steps_per_second)),
+        "compute_s_per_step": c2,
+        "sim_total_s": sim1.total_s + reshard_s + sim2.total_s,
+        "sim_comm_s": sim1.total_comm_s + reshard_s + sim2.total_comm_s,
+        "sim_compute_s": sim1.total_compute_s + sim2.total_compute_s,
+        "reshard_s": reshard_s,
+        "cold_restart_s": cold_s,
+        "reshard_bytes": events_tx_bytes(rev),
+        "overlap": cfg.overlap,
+        "cum_comm_bytes": cum,
+        "trace_tx_bytes": trace,
+        "reconcile_rel_err": rel_err,
+        "reconciled": rel_err <= 1e-5,
+    }
+
+
 def run_cell(cell: Cell, cfg: SweepConfig) -> Dict[str, Any]:
     """One grid cell: real fit with network simulation attached."""
     from .. import Trainer
+
+    if cell.event is not None:
+        return _run_event_cell(cell, cfg)
 
     model, ds = _workload(cfg, cell.nodes)
     strategy = make_strategy(cell.strategy, cell.H, cfg.lr, cell.codec,
@@ -333,6 +484,7 @@ def run_cell(cell: Cell, cfg: SweepConfig) -> Dict[str, Any]:
         "bits": cell.bits,
         "nodes": cell.nodes,
         "topology": cell.preset,
+        "event": cell.event,
         "steps": res.steps,
         "final_train_loss": final_loss,
         "measured_it_s": float(summary.get("steps_per_second",
@@ -378,6 +530,8 @@ def _config_label(r: Dict[str, Any]) -> str:
     codec = _row_codec(r)
     if codec is not None:
         label += f" {codec}"
+    if r.get("event"):
+        label += f" {r['event']}"
     return label
 
 
@@ -583,6 +737,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "dynamiq): dense, int8, int4, topk")
     p.add_argument("--topk_frac", type=float, default=0.05,
                    help="kept fraction for the topk codec cells")
+    p.add_argument("--events", default="none",
+                   help="comma list of membership events: none, "
+                        "join@<step>, leave@<step> — an event cell runs "
+                        "K nodes to the step then elastically resumes "
+                        "at K±1, pricing the reshard on the preset")
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--batch_size", type=int, default=8)
     p.add_argument("--block_size", type=int, default=64)
@@ -613,6 +772,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         H=[int(x) for x in _csv_list(args.H)],
         bits=[int(x) for x in _csv_list(args.bits)],
         codecs=_csv_list(args.codecs),
+        events=_csv_list(args.events),
         topk_frac=args.topk_frac,
         steps=args.steps, batch_size=args.batch_size,
         block_size=args.block_size, n_layer=args.n_layer,

@@ -20,7 +20,7 @@ from .fedavg import AveragingCommunicator, FedAvgStrategy
 from .noloco import NoLoCoCommunicator, NoLoCoStrategy
 from .optim import OptimSpec, ensure_optim_spec
 from .simple_reduce import SimpleReduceStrategy
-from .zero_reduce import ZeroReduceStrategy
+from .zero_reduce import NodeCountMismatchError, ZeroReduceStrategy
 from .sparta import (IndexSelector, PartitionedIndexSelector,
                      RandomIndexSelector, ShuffledSequentialIndexSelector,
                      SparseCommunicator, SPARTAStrategy)
@@ -29,6 +29,7 @@ from .sparta_diloco import SPARTADiLoCoStrategy
 __all__ = [
     "Strategy",
     "StrategyLifecycleError",
+    "NodeCountMismatchError",
     "CollectiveEvent",
     "OptimSpec",
     "ensure_optim_spec",

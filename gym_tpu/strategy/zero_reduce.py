@@ -41,7 +41,33 @@ from .optim import OptimSpec, ensure_optim_spec
 from .sharding import pipe_unwrap, pipe_wrap, shard_size, unshard
 
 
+class NodeCountMismatchError(StrategyLifecycleError):
+    """Sharded state built for K nodes was fed to a step on K' != K.
+
+    ZeRO shards are 1/K slices of the flat parameter vector, so the
+    optimizer-state shapes pin the node count a checkpoint was written
+    at. Resuming at a different K needs an explicit reshard — pass
+    ``fit(resume=..., num_nodes=K')`` and the elastic path
+    (``gym_tpu.elastic``) redistributes the slices.
+    """
+
+
+def _fallback_comm_bytes(k: int, grads: PyTree, params: PyTree) -> float:
+    """Per-node wire bytes of the pmean+slice fallback schedule: a full
+    gradient all-reduce (2(K−1)/K·|g|) plus the updated-slice all_gather
+    ((K−1)/K·|θ|). Shared by the pipeline-clip and vnode branches —
+    ``comm_events``/trace reconciliation depends on this exact formula."""
+    return ((k - 1) / max(k, 1)
+            * (2.0 * tree_bytes(grads) + tree_bytes(params)))
+
+
 class ZeroReduceStrategy(Strategy):
+    # ZeRO-2-style durable ownership: checkpoints store each node's 1/K
+    # flat parameter slice (plus the already-sharded moments) instead of
+    # the stacked [K, ...] replicas — the trainer's checkpoint codec
+    # keys off this flag (ckpt bytes and writer device_get drop to
+    # O(model), i.e. O(model/K) per node, instead of O(K·model)).
+    shard_checkpoint = True
     def __init__(
         self,
         optim_spec: Optional[Union[str, OptimSpec]] = None,
@@ -70,11 +96,23 @@ class ZeroReduceStrategy(Strategy):
         return pipe_wrap({"opt": self.tx.init(shard)}, self._ctx)
 
     def step(self, grads, params, state, step, ctx):
-        # shard size from the step ctx (init's bound ctx must agree — the
-        # opt-state shapes pin it, so a mismatched K fails loudly in optax)
+        # shard size from the step ctx; the opt-state shapes pin the K
+        # the state was built at, so a membership mismatch is detectable
+        # here at trace time — raise the typed error instead of letting
+        # optax fail on an opaque shape mismatch deep in tx.update
         k = ctx.num_nodes
         shard = shard_size(params, k)
         state = pipe_unwrap(state, ctx)
+        saved = {x.shape[0] for x in jax.tree.leaves(state["opt"])
+                 if getattr(x, "ndim", 0) == 1}
+        if saved and saved != {shard}:
+            raise NodeCountMismatchError(
+                f"ZeRO optimizer state holds shards of {sorted(saved)} "
+                f"elements but the mesh has num_nodes={k} (shard size "
+                f"{shard}). The state was built for a different node "
+                "count — resume elastically with fit(resume=..., "
+                f"num_nodes={k}) so gym_tpu.elastic reshards it, or run "
+                "at the original K.")
         flat_g, _ = ravel_pytree(grads)
         flat_p, unravel = ravel_pytree(params)
         pad = k * shard - flat_g.size
@@ -92,8 +130,7 @@ class ZeroReduceStrategy(Strategy):
             gm = self._maybe_clip(gm, ctx)
             fg, _ = ravel_pytree(gm)
             g_my = lax.dynamic_slice(jnp.pad(fg, (0, pad)), (off,), (shard,))
-            comm = ((k - 1) / max(k, 1)
-                    * (2.0 * tree_bytes(grads) + tree_bytes(params)))
+            comm = _fallback_comm_bytes(k, grads, params)
         elif len(ctx.axes) == 1 and k > 1:
             # canonical ZeRO-1: reduce-scatter the gradient — each node
             # receives only its summed 1/K chunk. Clip semantics identical
@@ -113,8 +150,7 @@ class ZeroReduceStrategy(Strategy):
             flat_g = ctx.pmean(flat_g)
             flat_g = self._maybe_clip(flat_g)
             g_my = lax.dynamic_slice(flat_g, (off,), (shard,))
-            comm = ((k - 1) / max(k, 1)
-                    * (2.0 * tree_bytes(grads) + tree_bytes(params)))
+            comm = _fallback_comm_bytes(k, grads, params)
 
         # this node's 1/K slice: optimizer state exists ONLY for it
         p_my = lax.dynamic_slice(flat_p_pad, (off,), (shard,))

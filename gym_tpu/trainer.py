@@ -475,6 +475,8 @@ class Trainer:
         start_step = 0
         restored_extra: Dict[str, Any] = {}
         to_canon = from_canon = None
+        el_meta = None
+        zero2 = False
         # overlapped saves need a single-process world (multi-process Orbax
         # writes are collective) — the writer thread is gated accordingly
         ckpt_overlap = async_checkpoint and not multi
@@ -513,6 +515,28 @@ class Trainer:
                     lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype,
                                                        sharding=sh),
                     canon_shapes, canon_shardings)
+            # Elastic membership (ROADMAP: Elastic ZeRO) — single-process,
+            # non-pipeline runs record their (K, layout, n) in every
+            # checkpoint's meta so a later `fit(resume=..., num_nodes=K')`
+            # can route restore through the reshard path instead of
+            # failing a template restore; strategies that advertise
+            # `shard_checkpoint` (ZeroReduce) additionally write ZeRO-2
+            # sharded checkpoints via the to_canon/from_canon codec —
+            # ckpt bytes and the writer's device_get drop to O(model)
+            # total, O(model/K) per node.
+            elastic_ok = pipe_model is None and not multi
+            if elastic_ok:
+                from .elastic import (STACKED_LAYOUT, ZERO2_LAYOUT,
+                                      elastic_meta, make_zero2_codec,
+                                      param_leaf_specs)
+                _, _, _n_flat = param_leaf_specs(state.params)
+                zero2 = bool(getattr(strategy, "shard_checkpoint", False))
+                if zero2:
+                    to_canon, from_canon = make_zero2_codec(
+                        state, num_nodes)
+                el_meta = elastic_meta(
+                    num_nodes, ZERO2_LAYOUT if zero2 else STACKED_LAYOUT,
+                    _n_flat)
             # resume="auto" (default): restore the newest VALID checkpoint,
             # falling back past corrupt/torn step dirs; resume=<int>: that
             # exact step or raise; resume="never"/False: purge this
@@ -525,8 +549,33 @@ class Trainer:
                     ckpt.purge()
             else:
                 want_step = resume_step_pin
-                template = (restore_template if from_canon is not None
-                            else state)
+                # Peek the saved membership/layout BEFORE committing to a
+                # restore template: a template restore in the LIVE shapes
+                # against a mismatched (K, layout) checkpoint would
+                # quarantine perfectly valid step dirs as 'corrupt'.
+                # Elastic restores instead use a numpy template in the
+                # SAVED shapes but the live tree STRUCTURE — numpy leaves
+                # carry no shardings (so Orbax never pins the saving
+                # mesh's device topology), and the structure-preserving
+                # template keeps optax namedtuples intact for the reshard
+                # walk.
+                saved_el = None
+                if elastic_ok and ckpt.latest_step() is not None:
+                    peek = ckpt.peek_meta(step=want_step)
+                    saved_el = ((peek or {}).get("extra") or {}).get(
+                        "elastic")
+                use_raw = elastic_ok and (
+                    zero2 or (saved_el is not None
+                              and (int(saved_el["num_nodes"]) != num_nodes
+                                   or saved_el.get("layout")
+                                   != el_meta["layout"])))
+                if use_raw:
+                    from .elastic import saved_state_template
+                    template = saved_state_template(state, saved_el)
+                elif from_canon is not None:
+                    template = restore_template
+                else:
+                    template = state
                 try:
                     start_step, restored, data_state, restored_extra = \
                         ckpt.restore(template, step=want_step)
@@ -545,7 +594,37 @@ class Trainer:
                         raise
                     # fresh run: nothing (valid) to resume from
                 else:
-                    if from_canon is not None:
+                    if use_raw:
+                        same_membership = (
+                            saved_el is not None
+                            and int(saved_el["num_nodes"]) == num_nodes
+                            and saved_el.get("layout") == el_meta["layout"])
+                        if same_membership and zero2:
+                            # same K, same layout: decode the sharded
+                            # checkpoint back to the live stacked state
+                            # (the registry-tracked unshard program — a
+                            # fresh-buffer jit, so no decouple needed)
+                            state = from_canon(restored)
+                        else:
+                            # membership or layout changed: redistribute
+                            # through the registry's reshard programs,
+                            # then land fresh buffers on the mesh
+                            from .elastic import reshard_state
+                            import jax.numpy as jnp
+                            state = jax.jit(
+                                lambda t: jax.tree.map(jnp.copy, t))(
+                                reshard_state(restored, saved_el, state))
+                            k_saved = (int(saved_el["num_nodes"])
+                                       if saved_el else num_nodes)
+                            if k_saved != num_nodes:
+                                # per-node data cursors are meaningless
+                                # across a membership change: keep the
+                                # epoch, restart intra-epoch positions
+                                data_state = {
+                                    "epoch": int(data_state.get("epoch",
+                                                                0)),
+                                    "pos": [0] * num_nodes}
+                    elif from_canon is not None:
                         state = from_canon(restored)
                     else:
                         # Decouple the restored arrays from the restore
@@ -915,6 +994,9 @@ class Trainer:
             # a resume continues it bit-exactly (the CSV's %.0f-rounded
             # cum column is only the fallback for pre-existing runs)
             extra = {"cum_comm_bytes": logger.cum_comm_bytes}
+            if el_meta is not None:
+                # the membership record the elastic resume path peeks
+                extra["elastic"] = el_meta
             canon = to_canon(state) if to_canon is not None else None
             if sync or not ckpt_overlap:
                 # serial save: multi-process lockstep write, the

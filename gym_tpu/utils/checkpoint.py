@@ -311,10 +311,16 @@ class CheckpointManager:
     def _restore_step(self, step: int, template_state: PyTree
                       ) -> Tuple[int, PyTree, dict, dict]:
         ocp = self._ocp
+        # template=None → Orbax's template-free read: the tree comes back
+        # exactly as saved (host arrays). The elastic resume path uses
+        # this — the saved (K, layout) need not match the live state.
+        state_arg = (ocp.args.StandardRestore(template_state)
+                     if template_state is not None
+                     else ocp.args.StandardRestore())
         restored = self.manager.restore(
             step,
             args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(template_state),
+                state=state_arg,
                 meta=ocp.args.JsonRestore(),
             ),
         )
@@ -322,6 +328,37 @@ class CheckpointManager:
         return int(step), restored["state"], dict(meta["data_state"]), dict(
             meta.get("extra", {})
         )
+
+    def peek_meta(self, step: Optional[int] = None) -> Optional[dict]:
+        """Read ONLY the JSON meta of the newest (or pinned) committed
+        step: ``{"data_state": ..., "extra": ...}``, or None when no step
+        has readable meta. The trainer peeks this BEFORE choosing a
+        restore template — the saved membership/layout
+        (``extra["elastic"]``) decides whether the plain template restore
+        applies or the elastic reshard path must run; attempting a
+        template restore against a mismatched layout would quarantine
+        perfectly valid checkpoints as 'corrupt'."""
+        ocp = self._ocp
+        steps = sorted(self.manager.all_steps(), reverse=True)
+        if step is not None:
+            steps = [step] if step in steps else []
+        for s in steps:
+            try:
+                restored = self.manager.restore(
+                    s, args=ocp.args.Composite(meta=ocp.args.JsonRestore()))
+                return dict(restored["meta"])
+            except Exception:  # noqa: BLE001 — peek is best-effort
+                continue
+        return None
+
+    def restore_raw(self, step: Optional[int] = None
+                    ) -> Tuple[int, PyTree, dict, dict]:
+        """Template-free ``restore``: the state tree exactly as saved
+        (host arrays, whatever K/layout it was written at), with the same
+        newest-first walk, corrupt-step quarantine and manager reload as
+        the template path. The elastic resume path reads through this and
+        reshards the result onto the live membership."""
+        return self.restore(None, step=step)
 
     def restore(self, template_state: PyTree,
                 step: Optional[int] = None) -> Tuple[int, PyTree, dict, dict]:

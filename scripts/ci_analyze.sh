@@ -46,14 +46,21 @@ for name, summ in sections["trace"]["strategies"].items():
 assert len(sections["trace"]["strategies"]) >= 16
 # ISSUE 11 bump: + the quantized serving family (int8 weights + int8
 # paged KV — paged prefill x2, CoW, paged decode, spec decode);
-# ISSUE 12: + the 4 compressed-outer-loop trainer steps
-assert len(sections["audit"]["programs"]) >= 30
-# ISSUE 9 gate: the auditor's serve key set and the device-program
-# registry's key set are THE SAME set — enumeration and acquisition
-# cannot drift apart
+# ISSUE 12: + the 4 compressed-outer-loop trainer steps;
+# ISSUE 16: + the 6 elastic redistribution programs (reshard_flat x3,
+# replicate_rows x2, unshard_params)
+assert len(sections["audit"]["programs"]) >= 36
+# ISSUE 9 gate: the auditor's serve+elastic key set and the
+# device-program registry's key set are THE SAME set — enumeration and
+# acquisition cannot drift apart
 recon = sections["audit"]["registry"]
 assert recon["key_set_match"], recon
-assert recon["n_registry_keys"] == recon["n_audit_serve_keys"] >= 14, recon
+assert recon["n_registry_keys"] == recon["n_audit_serve_keys"] >= 20, recon
+# ISSUE 16 gate: the elastic reshard family is enumerated, audited and
+# donation-clean (violations==0 above covers the findings)
+enames = [p["name"] for p in sections["audit"]["programs"]
+          if p["name"].startswith("elastic.")]
+assert len(enames) >= 6, enames
 # ISSUE 11 gate: quantized programs are registered + audited with
 # dtype-tagged names, donation-clean (violations==0 above covers them)
 qnames = [p["name"] for p in sections["audit"]["programs"]

@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# Elastic-membership gate (ROADMAP: Elastic ZeRO) — the 8th CI gate,
+# run NEXT TO ci_tier1/ci_faults/ci_sim/ci_serve/ci_chaos/ci_deploy/
+# ci_analyze. Three layers:
+#
+# 1. the elastic suites: K→K'→K redistribution bit-identity (params AND
+#    optimizer state, padded tail included, uneven K', vnode-folded
+#    mesh), the typed mismatched-K error, zero recompiles on re-reshard
+#    at a warm registry, the O(model/K) sharded-checkpoint bytes — plus
+#    the kill drill: train → SIGKILL at a dispatch boundary → resume at
+#    K-1 and K+1 → tolerance-bounded loss, pre-kill CSV rows verbatim.
+# 2. the jaxpr audit restricted to the elastic redistribution programs:
+#    registered under canonical keys, donation-clean, callback-free,
+#    zero violations.
+# 3. the deterministic reshard-vs-cold-restart frontier gate against the
+#    committed baseline (logs/frontier/elastic_frontier.json).
+#
+# CPU-only, sized for the 2-core container (~2 min).
+#
+# Usage: scripts/ci_elastic.sh   (from the repo root or anywhere)
+set -o pipefail
+cd "$(dirname "$0")/.."
+rm -f /tmp/_elastic.log
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_elastic.py tests/test_elastic_drill.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_elastic.log
+rc=${PIPESTATUS[0]}
+echo ELASTIC_DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' \
+    /tmp/_elastic.log | tr -cd . | wc -c)
+[ "$rc" -ne 0 ] && exit "$rc"
+
+# the reshard program family audits clean: canonical registry keys,
+# nothing donated (checkpoint host arrays), no callbacks, no f64
+timeout -k 10 300 env JAX_PLATFORMS=cpu python - <<'EOF'
+from gym_tpu.analysis.jaxpr_audit import (audit_program,
+                                          elastic_program_specs)
+audits = [audit_program(s) for s in elastic_program_specs()]
+assert len(audits) >= 6, [a.name for a in audits]
+bad = {a.name: a.findings for a in audits if a.findings}
+assert not bad, bad
+print(f"ci_elastic: {len(audits)} reshard programs audit clean "
+      "(violations=0)")
+EOF
+rc=$?
+[ "$rc" -ne 0 ] && exit "$rc"
+
+# deterministic membership-event frontier vs the committed baseline
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m gym_tpu.sim.elastic_frontier \
+    --baseline logs/frontier/elastic_frontier.json
+rc=$?
+[ "$rc" -ne 0 ] && exit "$rc"
+echo "ci_elastic: OK"
+exit 0

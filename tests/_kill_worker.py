@@ -26,12 +26,19 @@ def main() -> None:
                          "at the dispatch boundary, so a kill at boundary "
                          "N deterministically finds earlier saves durable")
     ap.add_argument("--no-prefetch", action="store_true")
+    ap.add_argument("--num-nodes", type=int, default=2,
+                    help="data-parallel node count; the elastic drill "
+                         "(ISSUE 16) resumes at K±1 to exercise the "
+                         "reshard path")
     ap.add_argument("--strategy", default="simple",
-                    choices=["simple", "diloco_int4"],
+                    choices=["simple", "diloco_int4", "zero"],
                     help="simple: SimpleReduce SGD (the original harness "
                          "workload); diloco_int4: compressed DiLoCo whose "
                          "error-feedback residual must round-trip through "
-                         "checkpoint save/restore (ISSUE 12)")
+                         "checkpoint save/restore (ISSUE 12); zero: "
+                         "ZeroReduce AdamW with sharded (ZeRO-2) "
+                         "checkpoints — the elastic drill workload "
+                         "(ISSUE 16)")
     ap.add_argument("--result", default="")
     args = ap.parse_args()
 
@@ -43,7 +50,7 @@ def main() -> None:
     from gym_tpu import Trainer
     from gym_tpu.data import ArrayDataset
     from gym_tpu.strategy import (DiLoCoStrategy, OptimSpec,
-                                  SimpleReduceStrategy)
+                                  SimpleReduceStrategy, ZeroReduceStrategy)
     from gym_tpu.utils.compile_cache import enable_compilation_cache
 
     cache = os.environ.get("GYM_TPU_TEST_COMPILE_CACHE")
@@ -73,12 +80,14 @@ def main() -> None:
         # resumed trajectory is only bit-identical if it round-trips
         strategy = DiLoCoStrategy(optim_spec=OptimSpec("sgd", lr=0.05),
                                   H=2, codec="int4")
+    elif args.strategy == "zero":
+        strategy = ZeroReduceStrategy(OptimSpec("adamw", lr=0.05))
     else:
         strategy = SimpleReduceStrategy(OptimSpec("sgd", lr=0.05))
 
     res = Trainer(Tiny(), ArrayDataset(x, labels)).fit(
         strategy=strategy,
-        num_nodes=2, max_steps=args.max_steps, batch_size=16,
+        num_nodes=args.num_nodes, max_steps=args.max_steps, batch_size=16,
         minibatch_size=8, val_interval=0, show_progress=False, seed=3,
         checkpoint_interval=args.ckpt_interval, save_dir=args.save_dir,
         run_name="kill", log_dir=args.log_dir,
