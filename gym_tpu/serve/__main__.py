@@ -164,6 +164,20 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max_queue", type=int, default=64,
                    help="FCFS queue bound (backpressure: submits beyond "
                         "it wait, then 429)")
+    p.add_argument("--quotas", default=None, metavar="JSON",
+                   help="per-SLO-class token-rate quotas as JSON, e.g. "
+                        "'{\"batch\": {\"share\": 0.5}}' or "
+                        "'{\"interactive\": {\"tokens_per_s\": 500}}' "
+                        "(share = fraction of the live tokens/s EWMA; "
+                        "exceeding the refill bucket -> 429 + "
+                        "Retry-After). Default: no quotas — the "
+                        "single-tenant behavior")
+    p.add_argument("--preempt", action="store_true",
+                   help="preemptible decode: park a low-priority "
+                        "running request at a chunk boundary when a "
+                        "strictly more urgent one is queued and no slot "
+                        "is free; the parked stream resumes "
+                        "byte-identical")
     p.add_argument("--request_timeout", type=float, default=600.0,
                    help="per-request wall-clock bound inside a handler")
     p.add_argument("--default-deadline", type=float, default=None,
@@ -272,7 +286,9 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
                   autoscale_interval_s: float = 1.0,
                   fleet_dir: Optional[str] = None,
                   worker_startup_timeout_s: float = 240.0,
-                  worker_env: Optional[Dict[str, str]] = None
+                  worker_env: Optional[Dict[str, str]] = None,
+                  quotas: Optional[Dict[str, Any]] = None,
+                  preempt: bool = False
                   ) -> ServerHandle:
     """Build the full serving stack — replica fleet (engines, schedulers,
     supervisors, router), metrics, HTTP server — WITHOUT entering
@@ -361,6 +377,7 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
             weights_tag=weights_tag,
             program_cache_dir=program_cache_dir,
             no_warmup=not warmup, device=None, env=worker_env,
+            quotas=quotas, preempt=preempt,
             log=lambda *a, **k: print(*a, file=sys.stderr, flush=True))
         router.start()
         router.wait_ready(n=replicas,
@@ -404,7 +421,7 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
             spec_tokens=spec_tokens if paged else 0, max_queue=max_queue,
             metrics=metrics, dispatch_timeout_s=dispatch_timeout,
             max_restarts=max_restarts, max_failovers=failover_retries,
-            weights_tag=weights_tag)
+            weights_tag=weights_tag, quotas=quotas, preempt=preempt)
         rep0 = router.replicas[0]
         sched, sup = rep0.scheduler, rep0.supervisor
         if warmup:
@@ -417,6 +434,35 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
             warm_thread = programs_mod.warm_engine_programs(
                 rep0.scheduler.engine, log=sys.stderr.write)
     char_level = cfg.vocab_size <= len(CHAR_VOCAB) + 1
+
+    def agg_tenant_snapshots(snaps):
+        """Fold per-replica ``tenant_snapshot``s into one /stats
+        ``tenants`` block: counters sum; per-class quota fill reports
+        the MOST CONSTRAINED replica (min — the fill a client's next
+        request actually prices against on the worst-placed replica)."""
+        agg: Dict[str, Any] = {"preemptions": 0, "resumes": 0,
+                               "parked": 0, "quota_rejections": {},
+                               "quota_fill": {}, "backlog_by_class": {}}
+        for s in snaps:
+            if not s:
+                continue
+            agg["preemptions"] += int(s.get("preemptions", 0) or 0)
+            agg["resumes"] += int(s.get("resumes", 0) or 0)
+            agg["parked"] += int(s.get("parked", 0) or 0)
+            for k, v in (s.get("quota_rejections") or {}).items():
+                agg["quota_rejections"][k] = (
+                    agg["quota_rejections"].get(k, 0) + int(v or 0))
+            for k, v in (s.get("backlog_by_class") or {}).items():
+                agg["backlog_by_class"][k] = (
+                    agg["backlog_by_class"].get(k, 0) + int(v or 0))
+            for k, v in (s.get("quota_fill") or {}).items():
+                if v is None:
+                    agg["quota_fill"].setdefault(k, None)
+                else:
+                    prev = agg["quota_fill"].get(k)
+                    agg["quota_fill"][k] = (float(v) if prev is None
+                                            else min(prev, float(v)))
+        return agg
 
     def encode_text(text: str):
         table = {c: i for i, c in enumerate(CHAR_VOCAB)}
@@ -517,6 +563,11 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
                 "programs_compiled": programs_mod.xla_compile_counter(),
                 "warmup": (warm_thread.stats()
                            if warm_thread is not None else None),
+                # multi-tenant serving (ISSUE 17): live quota fill,
+                # preemption/park counters and per-class backlog
+                "tenants": agg_tenant_snapshots(
+                    [rep.scheduler.tenant_snapshot()
+                     for rep in router.replicas if not rep.dead]),
                 # pre-fleet surface: replica 0's supervisor state (the
                 # keys every existing dashboard/drill greps)
                 **sup.status(),
@@ -563,6 +614,9 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
                 "programs_compiled": programs_mod.xla_compile_counter(),
                 "autoscaler": (autoscaler.status()
                                if autoscaler is not None else None),
+                # multi-tenant block off the workers' health frames
+                "tenants": agg_tenant_snapshots(
+                    [r.get("tenants") for r in live]),
                 **fleet,
             })
 
@@ -614,6 +668,17 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
                 deadline = (default_deadline if deadline is None
                             else float(deadline))
                 stream = bool(body.get("stream", False))
+                # multi-tenant tags (ISSUE 17): body field wins over
+                # the header; both optional — absent = the default
+                # tenant/class (single-tenant behavior)
+                tenant = body.get("tenant",
+                                  self.headers.get("X-Tenant"))
+                slo_class = body.get("slo_class",
+                                     self.headers.get("X-SLO-Class"))
+                if tenant is not None:
+                    tenant = str(tenant)
+                if slo_class is not None:
+                    slo_class = str(slo_class)
             except (ValueError, KeyError, TypeError) as e:
                 self._reply(400, {"error": str(e)})
                 return
@@ -629,7 +694,8 @@ def create_server(params, cfg, *, host: str = "127.0.0.1", port: int = 0,
                              if getattr(router, "kind", "") == "process"
                              else {})
                 req = router.submit(prompt, sp, timeout=30.0,
-                                    deadline_s=deadline, **submit_kw)
+                                    deadline_s=deadline, tenant=tenant,
+                                    slo_class=slo_class, **submit_kw)
             except AdmissionRejectedError as e:
                 self._reply(429, {"error": str(e)},
                             retry_after_s=e.retry_after_s)
@@ -906,6 +972,17 @@ def main(argv=None) -> int:
                 f"server")
         return new_params, f"step-{new_info['step']}"
 
+    quotas = None
+    if getattr(args, "quotas"):
+        from .scheduler import ClassQuota
+        try:
+            quotas = {cls: ClassQuota(**spec)
+                      for cls, spec in json.loads(args.quotas).items()}
+        except (json.JSONDecodeError, TypeError, ValueError) as e:
+            print(f"gym_tpu.serve: bad --quotas JSON: {e}",
+                  file=sys.stderr)
+            return 1
+
     stop = threading.Event()
     handle = create_server(
         params, cfg, host=args.host, port=args.port,
@@ -927,7 +1004,8 @@ def main(argv=None) -> int:
         min_replicas=getattr(args, "min_replicas"),
         max_replicas=getattr(args, "max_replicas"),
         autoscale_interval_s=getattr(args, "autoscale_interval"),
-        worker_startup_timeout_s=getattr(args, "worker_startup_timeout"))
+        worker_startup_timeout_s=getattr(args, "worker_startup_timeout"),
+        quotas=quotas, preempt=getattr(args, "preempt"))
     httpd, metrics, router = handle.httpd, handle.metrics, handle.router
 
     watcher = None

@@ -110,6 +110,34 @@ class SamplingParams:
 
 
 @dataclasses.dataclass
+class ParkedSlot:
+    """Host-side snapshot of one preempted slot (paged engines only).
+    The block-table REFERENCES move into the snapshot — pages stay
+    pinned in the pool at their current refcounts, exactly like the
+    slot-owned write blocks the spec-decode rewind masks — so a later
+    ``resume`` continues the generation byte-identical to an
+    uncontended run: everything a decode dispatch reads about a slot
+    (block table, cursors, token history, sampling vectors, the
+    ``fold_in(base, gen_idx)`` key schedule) is per-step host input.
+    ``released`` marks a consumed snapshot (resumed or dropped)."""
+
+    block_table: np.ndarray
+    pos: int
+    hist: np.ndarray
+    prompt_len: int
+    next_tok: int
+    gen_idx: int
+    generated: int
+    max_new: int
+    eos: int
+    temp: float
+    top_k: int
+    top_p: float
+    base_key: np.ndarray
+    released: bool = False
+
+
+@dataclasses.dataclass
 class TokenEvent:
     """One generated token, as seen by the scheduler. ``poisoned`` marks
     a token from a quarantined slot (non-finite logits): the value is
@@ -142,6 +170,10 @@ class EngineStats:
     # speculative-decoding counters (0 with speculation off)
     spec_drafted: int = 0
     spec_accepted: int = 0
+    # preemptible-decode counters (ISSUE 17): slots parked for a more
+    # urgent request / parked snapshots resumed into a slot
+    preemptions: int = 0
+    resumes: int = 0
     # quantized-serving config echo (ISSUE 11): which dtypes this
     # engine's params and KV pools are stored in — ride on stats so
     # metrics/serve.csv/stats report them without reaching into config
@@ -902,6 +934,90 @@ class InferenceEngine:
         self._active[slot] = False
         self._release_pages(slot)
         self.stats.active_slots = int(self._active.sum())
+
+    # -- preemptible decode (park / resume) --------------------------------
+
+    def park(self, slot: int) -> ParkedSlot:
+        """Preempt an ACTIVE slot at a chunk boundary (between ``step``
+        dispatches): snapshot its entire host-side cursor state and
+        block table WITHOUT decreffing the pages — the snapshot owns the
+        references — deactivate the row, and return the snapshot. Pure
+        host bookkeeping: no device work, no copies of KV state. Paged
+        engines only (an unpaged slot's cache rows are overwritten
+        wholesale by the next admit, so nothing parkable survives)."""
+        if not self.paged:
+            raise ValueError(
+                "park() requires a paged engine — unpaged cache rows do "
+                "not survive the next admit")
+        if not self._active[slot]:
+            raise ValueError(f"slot {slot} is not active — nothing to park")
+        parked = ParkedSlot(
+            block_table=self._bt[slot].copy(),
+            pos=int(self._pos[slot]),
+            hist=self._hist[slot].copy(),
+            prompt_len=int(self._prompt_len[slot]),
+            next_tok=int(self._next_tok[slot]),
+            gen_idx=int(self._gen_idx[slot]),
+            generated=int(self._generated[slot]),
+            max_new=int(self._max_new[slot]),
+            eos=int(self._eos[slot]),
+            temp=float(self._temp[slot]),
+            top_k=int(self._top_k[slot]),
+            top_p=float(self._top_p[slot]),
+            base_key=self._base_keys[slot].copy())
+        self._active[slot] = False
+        # references moved to the snapshot: zero the row WITHOUT decref
+        # so release()/step()'s page sweep cannot double-free them
+        self._bt[slot] = 0
+        self.stats.preemptions += 1
+        self.stats.active_slots = int(self._active.sum())
+        return parked
+
+    def resume(self, parked: ParkedSlot) -> int:
+        """Restore a parked snapshot into a free slot. No device work —
+        the KV pool is shared across slots and the block table is a
+        per-dispatch host input, so the resumed generation continues
+        from exactly the token it was preempted at, byte-identical by
+        the per-token key schedule. Raises ``NoFreeSlotError`` when
+        every slot is busy (the scheduler checks first)."""
+        if parked.released:
+            raise ValueError("parked snapshot already consumed")
+        free = self.free_slots()
+        if not free:
+            raise NoFreeSlotError(
+                "no free slot to resume the parked request into")
+        slot = free[0]
+        self._bt[slot] = parked.block_table
+        self._pos[slot] = parked.pos
+        self._hist[slot] = parked.hist
+        self._prompt_len[slot] = parked.prompt_len
+        self._active[slot] = True
+        self._next_tok[slot] = parked.next_tok
+        self._gen_idx[slot] = parked.gen_idx
+        self._generated[slot] = parked.generated
+        self._max_new[slot] = parked.max_new
+        self._eos[slot] = parked.eos
+        self._temp[slot] = parked.temp
+        self._top_k[slot] = parked.top_k
+        self._top_p[slot] = parked.top_p
+        self._base_keys[slot] = parked.base_key
+        parked.released = True
+        self.stats.resumes += 1
+        self.stats.active_slots = int(self._active.sum())
+        return slot
+
+    def release_parked(self, parked: ParkedSlot) -> None:
+        """Drop a parked snapshot's page references without resuming it
+        (deadline/cancel/shutdown caught the request while parked).
+        Idempotent via the ``released`` flag."""
+        if parked.released:
+            return
+        parked.released = True
+        for pg in parked.block_table:
+            if pg:
+                self._alloc.decref(int(pg))
+        self.stats.kv_blocks_in_use = self._alloc.in_use()
+        self.stats.kv_blocks_cached = self._alloc.cached()
 
     def step(self, override_tokens: Optional[Dict[int, int]] = None
              ) -> List[TokenEvent]:

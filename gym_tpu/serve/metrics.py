@@ -103,6 +103,14 @@ HEADER = [
     # instead of reverse-engineering them from replica counts. Absent
     # in pre-servesim CSVs; read_headline tolerates both.
     "as_healthy", "as_starting", "as_backlog_tokens", "as_reason",
+    # multi-tenant serving (ISSUE 17): who a request row belongs to and
+    # which SLO class priced it. Request rows also gain two new status
+    # values — ``preempted`` (a running low-priority request parked at a
+    # chunk boundary to free its slot; an EVENT row, the request is
+    # still live) and ``resumed`` (the parked request got a slot back).
+    # Absent in pre-tenant CSVs; read_headline tolerates both (pinned,
+    # per repo convention).
+    "tenant", "slo_class",
 ]
 
 #: EWMA smoothing for the live tokens/s estimate (per driver tick with
@@ -240,6 +248,31 @@ class _ReplicaAgg:
         }
 
 
+class _ClassAgg:
+    """Per-SLO-class slice of the request counters + TTFT tail (the
+    ``classes`` section of ``headline()``; ISSUE 17). Caller holds the
+    collector's lock."""
+
+    __slots__ = ("done", "shed", "rejected", "preempted", "resumed",
+                 "ttfts")
+
+    def __init__(self):
+        self.done = self.shed = self.rejected = 0
+        self.preempted = self.resumed = 0
+        self.ttfts: deque = deque(maxlen=PERCENTILE_WINDOW)
+
+    def headline(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "requests_done": self.done,
+            "requests_shed": self.shed,
+            "requests_rejected": self.rejected,
+            "preemptions": self.preempted,
+            "resumes": self.resumed,
+        }
+        out.update(_percentiles(self.ttfts, "ttft"))
+        return out
+
+
 class ReplicaMetrics:
     """Replica-scoped facade over a shared ``ServeMetrics``: the exact
     collector interface a ``Scheduler``/``Supervisor`` consumes, with
@@ -260,11 +293,25 @@ class ReplicaMetrics:
         self.base.request_done(req, queue_depth, active_slots,
                                replica_id=self.replica_id, pid=self.pid)
 
-    def request_rejected(self, queue_depth: int,
-                         active_slots: int) -> None:
+    def request_rejected(self, queue_depth: int, active_slots: int,
+                         tenant: Optional[str] = None,
+                         slo_class: Optional[str] = None) -> None:
         self.base.request_rejected(queue_depth, active_slots,
                                    replica_id=self.replica_id,
-                                   pid=self.pid)
+                                   pid=self.pid, tenant=tenant,
+                                   slo_class=slo_class)
+
+    def request_preempted(self, req, queue_depth: int,
+                          active_slots: int) -> None:
+        self.base.request_preempted(req, queue_depth, active_slots,
+                                    replica_id=self.replica_id,
+                                    pid=self.pid)
+
+    def request_resumed(self, req, queue_depth: int,
+                        active_slots: int) -> None:
+        self.base.request_resumed(req, queue_depth, active_slots,
+                                  replica_id=self.replica_id,
+                                  pid=self.pid)
 
     def engine_tick(self, stats, queue_depth: int) -> None:
         self.base.engine_tick(stats, queue_depth,
@@ -312,6 +359,12 @@ class ServeMetrics:
         self.requests_quarantined = 0
         self.requests_rejected = 0
         self.requests_disconnected = 0
+        # multi-tenant serving (ISSUE 17): preempt/resume are EVENTS on
+        # live requests, not completions — their own counters, never
+        # inflating requests_done/failed
+        self.requests_preempted = 0
+        self.requests_resumed = 0
+        self._classes: Dict[str, _ClassAgg] = {}
         self.engine_restarts = 0
         self.engine_reloads = 0
         # out-of-process fleet counters (ISSUE 13): process-replica
@@ -392,6 +445,19 @@ class ServeMetrics:
             return None
         return self._replicas.setdefault(int(replica_id), _ReplicaAgg())
 
+    def _cls(self, slo_class: Optional[str]) -> Optional[_ClassAgg]:
+        if not slo_class:
+            return None
+        return self._classes.setdefault(str(slo_class), _ClassAgg())
+
+    @staticmethod
+    def _tenant_cells(req) -> List[Any]:
+        """The two ISSUE-17 columns for a request-row write — blank on
+        pre-tenant Request objects (duck-typed: metrics stays
+        import-decoupled from the scheduler)."""
+        return [str(getattr(req, "tenant", "") or ""),
+                str(getattr(req, "slo_class", "") or "")]
+
     @staticmethod
     def _rid_cell(replica_id: Optional[int]):
         return "" if replica_id is None else int(replica_id)
@@ -450,6 +516,13 @@ class ServeMetrics:
                 self._lat_sum += lat
                 self._lat_n += 1
                 self._lats.append(lat)
+            tenant_cells = self._tenant_cells(req)
+            agg = self._cls(tenant_cells[1])
+            if agg is not None:
+                agg.done += int(not failed)
+                agg.shed += int(status == "shed")
+                if ttft is not None:
+                    agg.ttfts.append(ttft)
             # submit offset in the collector's clock: the arrival
             # process, reconstructible from disk (ISSUE 15)
             t_sub = getattr(req, "submit_t", None)
@@ -464,15 +537,19 @@ class ServeMetrics:
                 self.tokens_out, f"{self.tokens_per_s():.2f}",
                 "", "", "", self._rid_cell(replica_id), "", "", "",
                 "", "", self._pid_cell(pid),
-                t_sub_cell, "", "", "", "",
+                t_sub_cell, "", "", "", "", *tenant_cells,
             ])
             self._f.flush()
 
     def request_rejected(self, queue_depth: int, active_slots: int,
                          replica_id: Optional[int] = None,
-                         pid: Optional[int] = None) -> None:
+                         pid: Optional[int] = None,
+                         tenant: Optional[str] = None,
+                         slo_class: Optional[str] = None) -> None:
         """Admission control shed a request before it was enqueued (no
-        Request object ever existed — the whole point)."""
+        Request object ever existed — the whole point). ``tenant`` /
+        ``slo_class`` type WHO was turned away (quota rejects are the
+        per-class observable; blank on pre-tenant callers)."""
         with self._lock:
             if self._f.closed:
                 return
@@ -480,6 +557,9 @@ class ServeMetrics:
             rep = self._rep(replica_id)
             if rep is not None:
                 rep.rejected += 1
+            agg = self._cls(slo_class)
+            if agg is not None:
+                agg.rejected += 1
             now = self._now()
             self._w.writerow([
                 f"{now:.4f}", "request", "", "rejected",
@@ -489,8 +569,59 @@ class ServeMetrics:
                 "", "", self._pid_cell(pid),
                 # an admission reject happens AT submit: arrival == now
                 f"{now:.4f}", "", "", "", "",
+                str(tenant or ""), str(slo_class or ""),
             ])
             self._f.flush()
+
+    def _request_event(self, req, status: str, queue_depth: int,
+                       active_slots: int, replica_id: Optional[int],
+                       pid: Optional[int]) -> None:
+        """A lifecycle EVENT row on a still-live request (ISSUE 17:
+        ``preempted`` / ``resumed``). new_tokens stays blank — the
+        request's tokens are counted once, on its completion row."""
+        tenant_cells = self._tenant_cells(req)
+        self._w.writerow([
+            f"{self._now():.4f}", "request", req.id, status,
+            queue_depth, active_slots, int(req.prompt.size), "",
+            "", "", self.tokens_out, f"{self.tokens_per_s():.2f}",
+            "", "", "", self._rid_cell(replica_id), "", "", "",
+            "", "", self._pid_cell(pid), "", "", "", "", "",
+            *tenant_cells,
+        ])
+        self._f.flush()
+
+    def request_preempted(self, req, queue_depth: int,
+                          active_slots: int,
+                          replica_id: Optional[int] = None,
+                          pid: Optional[int] = None) -> None:
+        """A running low-priority request was parked at a chunk boundary
+        to free its slot for more urgent work (ISSUE 17). The request is
+        still live: its stream pauses and later resumes byte-identical,
+        so this is an event counter, never a failure."""
+        with self._lock:
+            if self._f.closed:
+                return
+            self.requests_preempted += 1
+            agg = self._cls(getattr(req, "slo_class", None))
+            if agg is not None:
+                agg.preempted += 1
+            self._request_event(req, "preempted", queue_depth,
+                                active_slots, replica_id, pid)
+
+    def request_resumed(self, req, queue_depth: int, active_slots: int,
+                        replica_id: Optional[int] = None,
+                        pid: Optional[int] = None) -> None:
+        """A parked (preempted) request got a slot back and its stream
+        continues from the parked cursor (ISSUE 17)."""
+        with self._lock:
+            if self._f.closed:
+                return
+            self.requests_resumed += 1
+            agg = self._cls(getattr(req, "slo_class", None))
+            if agg is not None:
+                agg.resumed += 1
+            self._request_event(req, "resumed", queue_depth,
+                                active_slots, replica_id, pid)
 
     def engine_restarted(self, replica_id: Optional[int] = None,
                          pid: Optional[int] = None) -> None:
@@ -508,7 +639,7 @@ class ServeMetrics:
                 f"{self.tokens_per_s():.2f}", "", "", "",
                 self._rid_cell(replica_id), *self._program_cells(),
                 self._weights_dtype or "", self._kv_dtype or "",
-                self._pid_cell(pid), "", "", "", "", "",
+                self._pid_cell(pid), "", "", "", "", "", "", "",
             ])
             self._f.flush()
 
@@ -529,7 +660,7 @@ class ServeMetrics:
                 f"{self.tokens_per_s():.2f}", "", "", "",
                 self._rid_cell(replica_id), *self._program_cells(),
                 self._weights_dtype or "", self._kv_dtype or "",
-                self._pid_cell(pid), "", "", "", "", "",
+                self._pid_cell(pid), "", "", "", "", "", "", "",
             ])
             self._f.flush()
 
@@ -559,7 +690,7 @@ class ServeMetrics:
                  else f"{tokens_per_s:.2f}"),
                 "", "", "", "", "", "", "", "", "", "",
                 "", int(healthy), int(starting),
-                f"{float(backlog_tokens):.1f}", str(reason),
+                f"{float(backlog_tokens):.1f}", str(reason), "", "",
             ])
             self._f.flush()
 
@@ -609,7 +740,7 @@ class ServeMetrics:
                 kv, ph, ("" if sr is None else f"{sr:.4f}"),
                 self._rid_cell(replica_id), *self._program_cells(),
                 self._weights_dtype or "", self._kv_dtype or "",
-                self._pid_cell(pid), "", "", "", "", "",
+                self._pid_cell(pid), "", "", "", "", "", "", "",
             ])
 
     def tokens_per_s(self) -> float:
@@ -662,6 +793,8 @@ class ServeMetrics:
                 "requests_quarantined": self.requests_quarantined,
                 "requests_rejected": self.requests_rejected,
                 "requests_disconnected": self.requests_disconnected,
+                "requests_preempted": self.requests_preempted,
+                "requests_resumed": self.requests_resumed,
                 "engine_restarts": self.engine_restarts,
                 "engine_reloads": self.engine_reloads,
                 "replicas_spawned": self.replicas_spawned,
@@ -702,6 +835,14 @@ class ServeMetrics:
                 head["replicas"] = {
                     str(rid): rep.headline()
                     for rid, rep in sorted(self._replicas.items())}
+            if self._classes:
+                # per-SLO-class tails + shed/preempt counters (ISSUE
+                # 17): the isolation observable — a noisy neighbor
+                # shows up as ITS class's rejects/preempts while the
+                # victim class's ttft_p99_s stays put
+                head["classes"] = {
+                    cls: agg.headline()
+                    for cls, agg in sorted(self._classes.items())}
             head.update(_percentiles(self._ttfts, "ttft"))
             head.update(_percentiles(self._lats, "token_lat"))
             return head
@@ -745,7 +886,19 @@ def read_headline(path: str) -> Dict[str, Any]:
     pre-fleet CSVs (no such column, like pre-paging CSVs lack the KV
     columns) produce the same fleet-free headline they always did."""
     counts = {"done": 0, "failed": 0, "shed": 0, "quarantined": 0,
-              "rejected": 0, "disconnected": 0}
+              "rejected": 0, "disconnected": 0,
+              # ISSUE 17 event rows (absent in pre-tenant CSVs)
+              "preempted": 0, "resumed": 0}
+    per_cls: Dict[str, Dict[str, Any]] = {}
+
+    def cls_of(row):
+        slo = row.get("slo_class")
+        if not slo:
+            return None
+        return per_cls.setdefault(str(slo), {
+            "requests_done": 0, "requests_shed": 0,
+            "requests_rejected": 0, "preemptions": 0, "resumes": 0,
+            "_ttfts": []})
     restarts = reloads = 0
     tokens_out = 0
     last_ts = 0.0
@@ -821,6 +974,18 @@ def read_headline(path: str) -> Dict[str, Any]:
                 rep["requests_failed"] += int(
                     status in ("failed", "shed", "quarantined"))
                 rep["tokens_out"] += int(row["new_tokens"] or 0)
+            cls = cls_of(row)
+            if cls is not None:
+                cls["requests_done"] += int(status == "done")
+                cls["requests_shed"] += int(status == "shed")
+                cls["requests_rejected"] += int(status == "rejected")
+                cls["preemptions"] += int(status == "preempted")
+                cls["resumes"] += int(status == "resumed")
+                if status not in ("preempted", "resumed") \
+                        and row["ttft_s"]:
+                    cls["_ttfts"].append(float(row["ttft_s"]))
+            if status in ("preempted", "resumed"):
+                continue       # event rows: no latency samples
             if row["ttft_s"]:
                 ttfts.append(float(row["ttft_s"]))
             if row["avg_token_latency_s"]:
@@ -833,6 +998,8 @@ def read_headline(path: str) -> Dict[str, Any]:
         "requests_quarantined": counts["quarantined"],
         "requests_rejected": counts["rejected"],
         "requests_disconnected": counts["disconnected"],
+        "requests_preempted": counts["preempted"],
+        "requests_resumed": counts["resumed"],
         "engine_restarts": restarts,
         "engine_reloads": reloads,
         "tokens_out": tokens_out,
@@ -855,6 +1022,13 @@ def read_headline(path: str) -> Dict[str, Any]:
                              "downs": as_downs}
     if per_rep:
         head["replicas"] = dict(sorted(per_rep.items()))
+    if per_cls:
+        classes: Dict[str, Any] = {}
+        for slo, agg in sorted(per_cls.items()):
+            samples = agg.pop("_ttfts")
+            agg.update(_percentiles(samples, "ttft"))
+            classes[slo] = agg
+        head["classes"] = classes
     head.update(_percentiles(ttfts, "ttft"))
     head.update(_percentiles(lats, "token_lat"))
     return head

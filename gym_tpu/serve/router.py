@@ -72,10 +72,10 @@ import numpy as np
 from ..utils.resilience import dump_thread_stacks
 from . import wire
 from .engine import InferenceEngine, SamplingParams
-from .scheduler import (AdmissionRejectedError, DeadlineExceededError,
-                        EngineFailedError, QueueFullError, Request,
-                        RequestCancelledError, RequestStatus, Scheduler,
-                        SchedulerClosedError)
+from .scheduler import (CLASS_PRIORITY, AdmissionRejectedError,
+                        DeadlineExceededError, EngineFailedError,
+                        QueueFullError, Request, RequestCancelledError,
+                        RequestStatus, Scheduler, SchedulerClosedError)
 from .supervisor import Supervisor
 
 PyTree = Any
@@ -137,12 +137,15 @@ class FleetRequest:
 
     def __init__(self, router: "Router", prompt: np.ndarray,
                  sampling: SamplingParams, deadline_s: Optional[float],
-                 submit_t: float):
+                 submit_t: float, tenant: Optional[str] = None,
+                 slo_class: Optional[str] = None):
         self._router = router
         self.prompt = prompt
         self.sampling = sampling
         self.deadline_s = deadline_s
         self.submit_t = submit_t
+        self.tenant = tenant
+        self.slo_class = slo_class
         self.failovers = 0
         self.replica_id: int = -1
         self._inner: Optional[Request] = None
@@ -330,16 +333,36 @@ class Router:
     # -- dispatch ---------------------------------------------------------
 
     def _score(self, rep: Replica, prompt: np.ndarray,
-               sp: SamplingParams) -> float:
+               sp: SamplingParams,
+               slo_class: Optional[str] = None) -> float:
         """Lower = better: committed backlog tokens minus the resident
         shared-prefix bonus (tokens of prefill work the replica's paged
         cache would elide). The probe reads allocator state owned by the
         replica's driver thread — it is ADVISORY, so a racing mutation
-        degrades to bonus 0, never to a failed dispatch."""
-        load = float(rep.scheduler.backlog_tokens())
+        degrades to bonus 0, never to a failed dispatch.
+
+        Class-aware (ISSUE 17): when the replica can PREEMPT, backlog
+        belonging to strictly lower-priority classes barely counts
+        against a more urgent request — a batch flood parked on one
+        replica must not strand interactive traffic fleet-wide when
+        that replica would simply park the batch decode. Without
+        preemption the full backlog is the honest wait, so no discount.
+        """
+        sched = rep.scheduler
+        load = float(sched.backlog_tokens())
+        pri = CLASS_PRIORITY.get(slo_class) if slo_class else None
+        if pri is not None and getattr(sched, "preempt", False):
+            try:
+                lower = sum(
+                    tok for cls, tok in
+                    sched.backlog_tokens_by_class().items()
+                    if CLASS_PRIORITY.get(cls, 1) > pri)
+                load -= 0.75 * lower
+            except Exception:  # noqa: BLE001 — advisory, like the probe
+                pass
         bonus = 0.0
         try:
-            eng = rep.scheduler.engine
+            eng = sched.engine
             if getattr(eng, "paged", False):
                 bonus = (eng.admit_probe(prompt, sp)[1] * eng.page_size
                          * self.prefix_bonus_weight)
@@ -348,7 +371,8 @@ class Router:
         return load - bonus
 
     def _candidates(self, prompt: np.ndarray, sp: SamplingParams,
-                    exclude: Tuple[int, ...] = ()) -> List[Replica]:
+                    exclude: Tuple[int, ...] = (),
+                    slo_class: Optional[str] = None) -> List[Replica]:
         alive = [r for r in self.replicas
                  if not r.dead and r.id not in exclude]
         ready = [r for r in alive if not r.draining]
@@ -357,16 +381,21 @@ class Router:
         # engine — that is what makes the swap zero-downtime at N=1
         pool = ready or alive
         return sorted(pool,
-                      key=lambda r: (self._score(r, prompt, sp), r.id))
+                      key=lambda r: (self._score(r, prompt, sp,
+                                                 slo_class), r.id))
 
     def submit(self, prompt, sampling: Optional[SamplingParams] = None,
                block: bool = True, timeout: Optional[float] = 30.0,
-               deadline_s: Optional[float] = None) -> FleetRequest:
+               deadline_s: Optional[float] = None,
+               tenant: Optional[str] = None,
+               slo_class: Optional[str] = None) -> FleetRequest:
         """Dispatch to the best healthy replica. Same contract as
         ``Scheduler.submit`` (typed ``ValueError`` for bad requests,
         ``AdmissionRejectedError``/``QueueFullError`` backpressure,
         deadline caps the queue-full wait) plus
-        ``NoHealthyReplicaError`` when the whole fleet is dead."""
+        ``NoHealthyReplicaError`` when the whole fleet is dead.
+        ``tenant``/``slo_class`` ride through to the replica scheduler
+        (quotas, weighted-fair queuing, preemption priority)."""
         sampling = sampling or SamplingParams()
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         t_entry = time.perf_counter()
@@ -378,16 +407,20 @@ class Router:
         if deadline_s is not None:
             cap = deadline_s if cap is None else min(cap, deadline_s)
         wait_deadline = None if cap is None else t_entry + cap
-        fr = FleetRequest(self, prompt, sampling, deadline_s, t_entry)
+        fr = FleetRequest(self, prompt, sampling, deadline_s, t_entry,
+                          tenant=tenant, slo_class=slo_class)
         fr._inner, fr.replica_id = self._dispatch(
             prompt, sampling, deadline_s, exclude=(), block=block,
-            wait_deadline=wait_deadline)
+            wait_deadline=wait_deadline, tenant=tenant,
+            slo_class=slo_class)
         return fr
 
     def _dispatch(self, prompt: np.ndarray, sampling: SamplingParams,
                   deadline_s: Optional[float],
                   exclude: Tuple[int, ...], block: bool,
-                  wait_deadline: Optional[float]
+                  wait_deadline: Optional[float],
+                  tenant: Optional[str] = None,
+                  slo_class: Optional[str] = None
                   ) -> Tuple[Request, int]:
         """Try candidates best-first; degrade typed. ``exclude`` is a
         PREFERENCE (a failover avoids the replica that just failed it)
@@ -398,9 +431,11 @@ class Router:
                 if self._closing:
                     raise SchedulerClosedError(
                         "router shutting down — request not dispatched")
-            cands = self._candidates(prompt, sampling, exclude)
+            cands = self._candidates(prompt, sampling, exclude,
+                                     slo_class)
             if not cands and exclude:
-                cands = self._candidates(prompt, sampling, ())
+                cands = self._candidates(prompt, sampling, (),
+                                         slo_class)
             if not cands:
                 raise NoHealthyReplicaError(
                     f"all {len(self.replicas)} replica(s) are dead — "
@@ -411,7 +446,8 @@ class Router:
                 try:
                     req = rep.scheduler.submit(
                         prompt, sampling, block=False,
-                        deadline_s=deadline_s)
+                        deadline_s=deadline_s, tenant=tenant,
+                        slo_class=slo_class)
                     return req, rep.id
                 except AdmissionRejectedError as e:
                     rejects.append(e)
@@ -506,7 +542,8 @@ class Router:
         inner, rid = self._dispatch(
             fr.prompt, fr.sampling, rem_dl,
             exclude=(failed_rid,), block=True,
-            wait_deadline=wait_deadline)
+            wait_deadline=wait_deadline, tenant=fr.tenant,
+            slo_class=fr.slo_class)
         fr.failovers += 1
         with self._lock:
             self.failovers += 1
@@ -634,7 +671,9 @@ def build_fleet(params: PyTree, config, *, replicas: int = 1,
                 dispatch_timeout_s: float = 120.0, max_restarts: int = 5,
                 max_failovers: Optional[int] = None,
                 weights_tag: Optional[str] = None,
-                prefix_bonus_weight: float = 1.0, log=print) -> Router:
+                prefix_bonus_weight: float = 1.0,
+                quotas: Optional[Dict[str, Any]] = None,
+                preempt: bool = False, log=print) -> Router:
     """Construct a ``Router`` over N identical in-process replica
     stacks sharing one params tree and one metrics collector (each
     replica writes through its ``replica_view``). Supervisors are NOT
@@ -656,7 +695,8 @@ def build_fleet(params: PyTree, config, *, replicas: int = 1,
                 page_size=page_size, kv_pages=kv_pages,
                 spec_tokens=spec_tokens, weights_tag=box.get("tag"))
 
-        sched = Scheduler(factory(), max_queue=max_queue, metrics=view)
+        sched = Scheduler(factory(), max_queue=max_queue, metrics=view,
+                          quotas=quotas, preempt=preempt)
         sup = Supervisor(sched, factory,
                          dispatch_timeout_s=dispatch_timeout_s,
                          max_restarts=max_restarts, metrics=view, log=log)
@@ -709,7 +749,9 @@ class WorkerSpawner:
                  program_cache_dir: Optional[str] = None,
                  weights_tag: Optional[str] = None,
                  no_warmup: bool = False, device: Optional[str] = "cpu",
-                 env: Optional[Dict[str, str]] = None):
+                 env: Optional[Dict[str, str]] = None,
+                 quotas_json: Optional[str] = None,
+                 preempt: bool = False):
         self.base_dir = os.path.abspath(base_dir)
         os.makedirs(self.base_dir, exist_ok=True)
         self.params_file: Optional[str] = None
@@ -742,6 +784,8 @@ class WorkerSpawner:
         self.no_warmup = bool(no_warmup)
         self.device = device
         self.env = dict(env or {})
+        self.quotas_json = quotas_json
+        self.preempt = bool(preempt)
         self._reload_seq = itertools.count()
 
     @staticmethod
@@ -806,6 +850,10 @@ class WorkerSpawner:
             cmd += ["--weights-tag", str(self.weights_tag)]
         if self.no_warmup:
             cmd += ["--no-warmup"]
+        if self.quotas_json:
+            cmd += ["--quotas-json", self.quotas_json]
+        if self.preempt:
+            cmd += ["--preempt"]
         if self.device:
             cmd += ["--device", str(self.device)]
         env = dict(os.environ)
@@ -861,6 +909,20 @@ class ProcessReplica:
         return (float(self.last_health.get("backlog_tokens", 0) or 0)
                 + self.inflight_tokens)
 
+    def load_for(self, slo_class: Optional[str]) -> float:
+        """Class-aware dispatch load (ISSUE 17): when the worker can
+        PREEMPT, backlog belonging to strictly lower-priority classes
+        barely counts against a more urgent request — the in-process
+        ``Router._score`` discount, read off the health report."""
+        load = self.load()
+        pri = CLASS_PRIORITY.get(slo_class) if slo_class else None
+        if pri is None or not self.last_health.get("preempt"):
+            return load
+        by_cls = self.last_health.get("backlog_by_class") or {}
+        lower = sum(float(tok or 0) for cls, tok in by_cls.items()
+                    if CLASS_PRIORITY.get(cls, 1) > pri)
+        return load - 0.75 * lower
+
 
 class ProcRequest:
     """Process-fleet request handle — the same wait surface as
@@ -872,12 +934,15 @@ class ProcRequest:
 
     def __init__(self, router: "ProcessRouter", prompt: np.ndarray,
                  sampling: SamplingParams, deadline_s: Optional[float],
-                 submit_t: float):
+                 submit_t: float, tenant: Optional[str] = None,
+                 slo_class: Optional[str] = None):
         self._router = router
         self.prompt = prompt
         self.sampling = sampling
         self.deadline_s = deadline_s
         self.submit_t = submit_t
+        self.tenant = tenant
+        self.slo_class = slo_class
         self.tokens: List[int] = []
         self.failovers = 0
         self.replica_id = -1
@@ -1292,7 +1357,9 @@ class ProcessRouter:
                block: bool = True, timeout: Optional[float] = 30.0,
                deadline_s: Optional[float] = None,
                stream: bool = True,
-               coalesce_s: Optional[float] = None) -> ProcRequest:
+               coalesce_s: Optional[float] = None,
+               tenant: Optional[str] = None,
+               slo_class: Optional[str] = None) -> ProcRequest:
         """Same contract as ``Router.submit``: typed backpressure and
         health degradation, deadline caps the dispatch wait.
         ``stream=False`` marks a result-only request: the worker skips
@@ -1313,7 +1380,8 @@ class ProcessRouter:
         if deadline_s is not None:
             cap = deadline_s if cap is None else min(cap, deadline_s)
         wait_deadline = None if cap is None else t_entry + cap
-        pr = ProcRequest(self, prompt, sampling, deadline_s, t_entry)
+        pr = ProcRequest(self, prompt, sampling, deadline_s, t_entry,
+                         tenant=tenant, slo_class=slo_class)
         pr.streaming = bool(stream)
         pr.coalesce_s = coalesce_s
         self._dispatch_proc(pr, deadline_s, prefix=[], exclude=(),
@@ -1337,7 +1405,8 @@ class ProcessRouter:
                          if r.healthy and r.id not in exclude]
                 if not cands and exclude:
                     cands = [r for r in live if r.healthy]
-                cands.sort(key=lambda r: (r.load(), r.id))
+                cands.sort(key=lambda r: (r.load_for(pr.slo_class),
+                                          r.id))
                 n_live = len(live)
             if not cands:
                 starting = any(not r.connected and not r.dead
@@ -1366,6 +1435,13 @@ class ProcessRouter:
                              1.0, self.submit_ack_timeout_s - 5.0)}
                 if pr.coalesce_s is not None:
                     frame["coalesce_s"] = float(pr.coalesce_s)
+                # only when tagged: a default (single-tenant) frame
+                # stays byte-identical to the pre-tenant protocol, and
+                # an old worker never sees fields it would note about
+                if pr.tenant is not None:
+                    frame["tenant"] = str(pr.tenant)
+                if pr.slo_class is not None:
+                    frame["slo_class"] = str(pr.slo_class)
                 try:
                     self._send(rep, frame, timeout=10.0)
                     first = self._next_frame(
@@ -1713,6 +1789,11 @@ class ProcessRouter:
                 "restarts": h.get("engine_restarts", 0),
                 "weights_tag": h.get("weights_tag"),
                 "warmup": h.get("warmup"),
+                # multi-tenant observables off the health frame (ISSUE
+                # 17; absent from pre-tenant workers — a mixed fleet
+                # reports what each worker knows)
+                "backlog_by_class": h.get("backlog_by_class"),
+                "tenants": h.get("tenants"),
             })
         with self._lock:
             live = [r for r in reps if not r["retired"]]
@@ -1771,6 +1852,8 @@ def build_process_fleet(params: Any, config: Any, base_dir: str, *,
                         no_warmup: bool = False,
                         device: Optional[str] = "cpu",
                         env: Optional[Dict[str, str]] = None,
+                        quotas: Optional[Dict[str, Any]] = None,
+                        preempt: bool = False,
                         log=print) -> ProcessRouter:
     """``build_fleet``'s out-of-process twin: materialize the params
     snapshot under ``base_dir`` and stand up a ``ProcessRouter`` over
@@ -1783,6 +1866,11 @@ def build_process_fleet(params: Any, config: Any, base_dir: str, *,
         max_queue=max_queue, dispatch_timeout_s=dispatch_timeout_s,
         max_restarts=max_restarts, program_cache_dir=program_cache_dir,
         weights_tag=weights_tag, no_warmup=no_warmup, device=device,
-        env=env)
+        env=env,
+        quotas_json=(None if not quotas else json.dumps(
+            {cls: (dataclasses.asdict(q)
+                   if dataclasses.is_dataclass(q) else dict(q))
+             for cls, q in quotas.items()})),
+        preempt=preempt)
     return ProcessRouter(spawner, replicas=replicas, metrics=metrics,
                          max_failovers=max_failovers, log=log)

@@ -46,7 +46,7 @@ import sys
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional, Tuple, Union
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
@@ -82,6 +82,14 @@ class AdmissionRejectedError(RuntimeError):
         self.retry_after_s = float(retry_after_s)
 
 
+class QuotaExceededError(AdmissionRejectedError):
+    """Per-class token-rate quota exhausted at ``submit``: the request's
+    ``slo_class`` refill bucket cannot cover its committed tokens right
+    now. Subclasses ``AdmissionRejectedError`` so every existing
+    429 + ``Retry-After`` surface — the HTTP handler, the router's
+    cheapest-reject ladder, the wire frames — applies unchanged."""
+
+
 class EngineFailedError(RuntimeError):
     """The engine crashed or wedged under this request: its in-flight
     generation cannot be recovered (the KV cache died with the engine).
@@ -107,6 +115,111 @@ class RequestCancelledError(RuntimeError):
     ``status=disconnected`` (not a failure, not a traceback)."""
 
 
+#: Known SLO classes in priority order (most urgent first). Requests
+#: that name an unknown class are rejected at submit with a typed
+#: ValueError (HTTP 400) — a typo'd class silently mapping to a default
+#: priority would be an isolation hole.
+SLO_CLASSES = ("interactive", "standard", "batch")
+DEFAULT_SLO_CLASS = "standard"
+DEFAULT_TENANT = "default"
+#: Admission priority (lower = more urgent). Preemption only ever runs
+#: in favor of a STRICTLY more urgent class, so same-class traffic can
+#: never thrash slots back and forth.
+CLASS_PRIORITY = {"interactive": 0, "standard": 1, "batch": 2}
+#: Weighted-fair-queuing weights: a tenant's virtual finish time
+#: advances at cost/weight, so at equal sustained demand an interactive
+#: tenant receives 8x a batch tenant's token share.
+CLASS_WEIGHT = {"interactive": 8.0, "standard": 4.0, "batch": 1.0}
+
+
+@dataclasses.dataclass(frozen=True)
+class ClassQuota:
+    """Refill-bucket token quota for one SLO class. Exactly one of
+    ``tokens_per_s`` (absolute refill rate) or ``share`` (fraction of
+    the live ``tokens_per_s_ewma`` — the bucket refills at a slice of
+    whatever the engine is actually delivering) must be set.
+    ``burst_s`` sizes the bucket: a class may burst up to ``burst_s``
+    seconds of its refill rate before the rate limit bites."""
+
+    tokens_per_s: Optional[float] = None
+    share: Optional[float] = None
+    burst_s: float = 2.0
+
+    def __post_init__(self):
+        if (self.tokens_per_s is None) == (self.share is None):
+            raise ValueError(
+                "ClassQuota: set exactly one of tokens_per_s / share")
+        if self.tokens_per_s is not None and not self.tokens_per_s > 0:
+            raise ValueError(
+                f"tokens_per_s must be > 0, got {self.tokens_per_s}")
+        if self.share is not None and not 0 < self.share <= 1:
+            raise ValueError(
+                f"share must be in (0, 1], got {self.share}")
+        if not self.burst_s > 0:
+            raise ValueError(f"burst_s must be > 0, got {self.burst_s}")
+
+
+class _TokenBucket:
+    """Lazy-refill token bucket with an injectable clock (tests pin
+    refill determinism by stepping a fake clock; production uses
+    ``time.monotonic``). Called under the scheduler lock — no locking
+    of its own."""
+
+    def __init__(self, quota: ClassQuota, clock=time.monotonic):
+        self.quota = quota
+        self._clock = clock
+        self._level: Optional[float] = None   # None = start full
+        self._last = 0.0
+
+    def _rate(self, ewma: Optional[float]) -> Optional[float]:
+        """Resolve the refill rate in tokens/s; None = unenforceable
+        right now (share-based quota on a cold engine with no EWMA —
+        optimistic, the same stance the deadline admission takes)."""
+        if self.quota.tokens_per_s is not None:
+            return float(self.quota.tokens_per_s)
+        if ewma is None or ewma <= 0:
+            return None
+        return float(self.quota.share) * float(ewma)
+
+    def _refill(self, rate: float) -> float:
+        cap = rate * self.quota.burst_s
+        now = self._clock()
+        if self._level is None:
+            self._level = cap
+        else:
+            self._level = min(cap, self._level
+                              + (now - self._last) * rate)
+        self._last = now
+        return cap
+
+    def try_take(self, n: int,
+                 ewma: Optional[float]) -> Tuple[bool, float]:
+        """``(admitted, retry_after_s)``. A request larger than the
+        whole bucket is admitted whenever the bucket is FULL (its level
+        goes negative, which enforces the long-run rate) — otherwise a
+        single big request could never pass and would starve forever
+        instead of being rate-limited."""
+        rate = self._rate(ewma)
+        if rate is None:
+            return True, 0.0
+        cap = self._refill(rate)
+        need = float(n)
+        if self._level >= min(need, cap):
+            self._level -= need
+            return True, 0.0
+        return False, max(0.05, (min(need, cap) - self._level) / rate)
+
+    def fill_fraction(self, ewma: Optional[float]) -> Optional[float]:
+        """Live bucket fill in [0, 1] for ``/stats`` (None when the
+        rate is unresolvable). Refills as a side effect — harmless: the
+        level is a function of elapsed time either way."""
+        rate = self._rate(ewma)
+        if rate is None:
+            return None
+        cap = self._refill(rate)
+        return max(0.0, min(1.0, self._level / cap)) if cap else None
+
+
 class RequestStatus(enum.Enum):
     QUEUED = "queued"
     RUNNING = "running"
@@ -123,6 +236,8 @@ class Request:
     prompt: np.ndarray
     sampling: SamplingParams
     deadline_s: Optional[float] = None
+    tenant: str = DEFAULT_TENANT
+    slo_class: str = DEFAULT_SLO_CLASS
     status: RequestStatus = RequestStatus.QUEUED
     tokens: List[int] = dataclasses.field(default_factory=list)
     error: Optional[str] = None
@@ -130,6 +245,9 @@ class Request:
     submit_t: float = 0.0
     first_token_t: Optional[float] = None
     done_t: Optional[float] = None
+    preemptions: int = 0                  # times parked mid-decode
+    _wfq_start: float = dataclasses.field(default=0.0, repr=False)
+    _wfq_finish: float = dataclasses.field(default=0.0, repr=False)
     _event: threading.Event = dataclasses.field(
         default_factory=threading.Event, repr=False)
     _progress: threading.Condition = dataclasses.field(
@@ -190,6 +308,13 @@ class Request:
         return self.submit_t + self.deadline_s
 
     @property
+    def priority(self) -> int:
+        """Admission priority from the SLO class (lower = more
+        urgent); unknown classes rank as the default class."""
+        return CLASS_PRIORITY.get(self.slo_class,
+                                  CLASS_PRIORITY[DEFAULT_SLO_CLASS])
+
+    @property
     def ttft_s(self) -> Optional[float]:
         if self.first_token_t is None:
             return None
@@ -211,7 +336,10 @@ class Scheduler:
 
     def __init__(self, engine: InferenceEngine, max_queue: int = 64,
                  metrics=None, prefix_window: int = 8,
-                 starvation_rounds: int = 128):
+                 starvation_rounds: int = 128,
+                 quotas: Optional[Dict[str, ClassQuota]] = None,
+                 preempt: bool = False, max_preemptions: int = 4,
+                 quota_clock=time.monotonic):
         """``prefix_window``: how many queued requests the admit step may
         look ahead to prefer one whose prompt prefix is RESIDENT in the
         paged engine's prefix cache (most resident blocks win, FCFS
@@ -224,7 +352,22 @@ class Scheduler:
         requests kept admitting and re-pinning them), admission stops
         entirely until running slots drain and the head fits. Without
         it a large-block-need request could wait unboundedly under a
-        sustained stream of small ones."""
+        sustained stream of small ones.
+
+        ``quotas``: per-``slo_class`` refill-bucket token quotas
+        (``ClassQuota``); a submit whose class bucket is dry fails
+        typed ``QuotaExceededError`` (→ HTTP 429 + Retry-After). None
+        (the default) disables quota enforcement entirely.
+
+        ``preempt``: allow a STRICTLY more urgent queued request to
+        park the least urgent running slot at a chunk boundary (paged
+        engines only — parking is a host-side snapshot over pinned
+        pages). The parked request keeps its ``Request`` object and
+        stream; it resumes byte-identical once pressure clears, bounded
+        by ``max_preemptions`` parks per request and the same
+        ``starvation_rounds`` anti-starvation contract as the queue
+        head. ``quota_clock`` injects the bucket clock for
+        deterministic tests."""
         self.engine = engine
         self.max_queue = int(max_queue)
         self.metrics = metrics
@@ -232,6 +375,25 @@ class Scheduler:
         self.starvation_rounds = max(1, int(starvation_rounds))
         self._head_skip_id: Optional[int] = None
         self._head_skips = 0
+        self.quotas: Dict[str, ClassQuota] = dict(quotas or {})
+        self._buckets = {cls: _TokenBucket(q, quota_clock)
+                         for cls, q in self.quotas.items()}
+        self.preempt = bool(preempt)
+        self.max_preemptions = max(0, int(max_preemptions))
+        # parked (preempted) requests, oldest first: (Request, the
+        # engine's ParkedSlot snapshot). Parked requests stay RUNNING —
+        # their stream simply pauses and later resumes byte-identical.
+        self._parked: List[Tuple[Request, Any]] = []
+        self._parked_skip_id: Optional[int] = None
+        self._parked_skips = 0
+        self.preemptions = 0               # slots parked (cumulative)
+        self.resumes = 0                   # parked snapshots resumed
+        self.quota_rejections: Dict[str, int] = {}
+        # start-time-fair-queuing state: the system virtual time
+        # advances to the start tag of each admitted request; a
+        # tenant's next request starts at max(vtime, its last finish)
+        self._vtime = 0.0
+        self._tenant_finish: Dict[str, float] = {}
         self._lock = threading.Lock()
         self._drained = threading.Condition(self._lock)
         self._queue: deque = deque()
@@ -279,16 +441,28 @@ class Scheduler:
         backlog += sum(
             max(0, r.sampling.max_new_tokens - len(r.tokens))
             for r in self._by_slot.values())
+        backlog += sum(
+            max(0, r.sampling.max_new_tokens - len(r.tokens))
+            for r, _parked in self._parked)
         return (backlog + max_new) / rate
 
     def submit(self, prompt, sampling: Optional[SamplingParams] = None,
                block: bool = True, timeout: Optional[float] = 30.0,
-               deadline_s: Optional[float] = None) -> Request:
+               deadline_s: Optional[float] = None,
+               tenant: Optional[str] = None,
+               slo_class: Optional[str] = None) -> Request:
         fault_point("serve.admit")
         t_entry = time.perf_counter()
         sampling = sampling or SamplingParams()
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.engine.validate(prompt, sampling)   # typed ValueError, early
+        tenant = DEFAULT_TENANT if tenant is None else str(tenant)
+        slo_class = (DEFAULT_SLO_CLASS if slo_class is None
+                     else str(slo_class))
+        if slo_class not in CLASS_PRIORITY:
+            raise ValueError(
+                f"unknown slo_class {slo_class!r} (known: "
+                f"{', '.join(SLO_CLASSES)})")
         if deadline_s is not None and not deadline_s > 0:
             raise ValueError(
                 f"deadline_s must be > 0 (got {deadline_s}); omit it for "
@@ -303,13 +477,33 @@ class Scheduler:
         with self._drained:
             if not self._accepting:
                 raise SchedulerClosedError("scheduler is shutting down")
+            bucket = self._buckets.get(slo_class)
+            if bucket is not None:
+                ewma = (self.metrics.tokens_per_s_ewma()
+                        if self.metrics is not None else None)
+                ok, retry = bucket.try_take(
+                    sampling.max_new_tokens, ewma)
+                if not ok:
+                    self.quota_rejections[slo_class] = \
+                        self.quota_rejections.get(slo_class, 0) + 1
+                    if self.metrics is not None:
+                        self.metrics.request_rejected(
+                            queue_depth=len(self._queue),
+                            active_slots=self.engine.stats.active_slots,
+                            tenant=tenant, slo_class=slo_class)
+                    raise QuotaExceededError(
+                        f"slo_class={slo_class} token quota exhausted: "
+                        f"{sampling.max_new_tokens} committed tokens "
+                        f"exceed the class refill bucket — retry after "
+                        f"{retry:.2g}s", retry_after_s=retry)
             if deadline_s is not None:
                 est = self._estimate_service_s(sampling.max_new_tokens)
                 if est is not None and est > deadline_s:
                     if self.metrics is not None:
                         self.metrics.request_rejected(
                             queue_depth=len(self._queue),
-                            active_slots=self.engine.stats.active_slots)
+                            active_slots=self.engine.stats.active_slots,
+                            tenant=tenant, slo_class=slo_class)
                     raise AdmissionRejectedError(
                         f"deadline_s={deadline_s:.3g} infeasible: estimated "
                         f"service time {est:.3g}s at the current "
@@ -331,7 +525,17 @@ class Scheduler:
                     raise SchedulerClosedError("scheduler is shutting down")
             req = Request(id=next(self._ids), prompt=prompt,
                           sampling=sampling, deadline_s=deadline_s,
+                          tenant=tenant, slo_class=slo_class,
                           submit_t=t_entry)
+            # start-time fair queuing tags (arrival-stamped): start at
+            # max(system virtual time, this tenant's last finish);
+            # finish advances by cost/weight — the weighted-fair share
+            w = CLASS_WEIGHT.get(slo_class, 1.0)
+            cost = float(prompt.size + sampling.max_new_tokens)
+            req._wfq_start = max(self._vtime,
+                                 self._tenant_finish.get(tenant, 0.0))
+            req._wfq_finish = req._wfq_start + cost / w
+            self._tenant_finish[tenant] = req._wfq_finish
             self._queue.append(req)
             if deadline_s is not None:
                 self._queued_deadlines += 1
@@ -346,25 +550,71 @@ class Scheduler:
             return len(self._by_slot)
 
     def inflight(self) -> int:
-        """Requests the engine currently holds state for: running slots
-        plus one mid-``admit``. Queued requests do NOT count — they carry
-        no engine state and survive an engine swap untouched. The
-        router's rolling weight reload waits for this to reach 0."""
+        """Requests the engine currently holds state for: running slots,
+        parked (preempted — their pages stay pinned) and one
+        mid-``admit``. Queued requests do NOT count — they carry no
+        engine state and survive an engine swap untouched. The router's
+        rolling weight reload waits for this to reach 0."""
         with self._lock:
-            return (len(self._by_slot)
+            return (len(self._by_slot) + len(self._parked)
                     + (1 if self._admitting is not None else 0))
 
     def backlog_tokens(self) -> int:
         """Committed future work in tokens (queued max_new + remaining of
-        running + mid-admission) — the router's least-loaded dispatch
-        score. Same accounting as ``_estimate_service_s``'s backlog."""
+        running/parked + mid-admission) — the router's least-loaded
+        dispatch score. Same accounting as ``_estimate_service_s``'s
+        backlog."""
         with self._lock:
             t = sum(r.sampling.max_new_tokens for r in self._queue)
             t += sum(max(0, r.sampling.max_new_tokens - len(r.tokens))
                      for r in self._by_slot.values())
+            t += sum(max(0, r.sampling.max_new_tokens - len(r.tokens))
+                     for r, _parked in self._parked)
             if self._admitting is not None:
                 t += self._admitting.sampling.max_new_tokens
             return t
+
+    def backlog_tokens_by_class(self) -> Dict[str, int]:
+        """``backlog_tokens`` split by ``slo_class`` — the router's
+        class-aware dispatch input: a replica drowning in preemptible
+        batch backlog is still a fine home for interactive traffic."""
+        with self._lock:
+            return self._backlog_by_class_locked()
+
+    def _backlog_by_class_locked(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+
+        def add(req: Request, tokens: int) -> None:
+            out[req.slo_class] = out.get(req.slo_class, 0) + tokens
+
+        for r in self._queue:
+            add(r, r.sampling.max_new_tokens)
+        for r in self._by_slot.values():
+            add(r, max(0, r.sampling.max_new_tokens - len(r.tokens)))
+        for r, _parked in self._parked:
+            add(r, max(0, r.sampling.max_new_tokens - len(r.tokens)))
+        if self._admitting is not None:
+            add(self._admitting,
+                self._admitting.sampling.max_new_tokens)
+        return out
+
+    def tenant_snapshot(self) -> Dict[str, Union[int, Dict]]:
+        """Live multi-tenant observables for ``/stats``: per-class
+        quota fill (None = unresolvable/cold), preempt/resume/rejection
+        counters, parked depth and the per-class backlog."""
+        ewma = (self.metrics.tokens_per_s_ewma()
+                if self.metrics is not None else None)
+        with self._lock:
+            fills = {cls: b.fill_fraction(ewma)
+                     for cls, b in self._buckets.items()}
+            return {
+                "preemptions": self.preemptions,
+                "resumes": self.resumes,
+                "parked": len(self._parked),
+                "quota_rejections": dict(self.quota_rejections),
+                "quota_fill": fills,
+                "backlog_by_class": self._backlog_by_class_locked(),
+            }
 
     # -- caller-side cancellation (client disconnect) ---------------------
 
@@ -437,27 +687,50 @@ class Scheduler:
 
     def _pick_admit_index(self, engine: InferenceEngine) -> Optional[int]:
         """Index of the next queued request to admit (caller holds the
-        lock). FCFS, except that within the first ``prefix_window``
-        queued requests the one with the most prompt-prefix blocks
-        RESIDENT in the paged engine's prefix cache wins (FCFS breaks
-        ties) — admit ordering is the cheapest way to turn shared-prefix
-        bursts into cache hits before eviction churn loses them.
+        lock).
+
+        SINGLE tenant queued (the default deployment): FCFS, except
+        that within the first ``prefix_window`` queued requests the one
+        with the most prompt-prefix blocks RESIDENT in the paged
+        engine's prefix cache wins (FCFS breaks ties) — admit ordering
+        is the cheapest way to turn shared-prefix bursts into cache
+        hits before eviction churn loses them.
+
+        MULTIPLE tenants queued: weighted-fair queuing — the candidates
+        are each tenant's OLDEST queued request (per-tenant FIFO, so a
+        flooding tenant cannot push a quiet tenant's head out of any
+        bounded window) and the earliest virtual finish tag wins, with
+        the resident-prefix score as a bounded tie-break and FCFS after
+        that. At one tenant the candidate set and scoring degrade to
+        exactly the single-tenant path above.
+
         Requests the block pool cannot serve right now are passed over
         (running slots will free their blocks; ``engine.validate``
         guarantees every queued request fits an idle pool) — bounded by
         the starvation guard: once the HEAD request has been passed
         over ``starvation_rounds`` times — whether for lack of blocks
-        OR because hotter-prefix requests kept outscoring it — it is
-        the only admissible choice: admit it, or (if the pool still
-        can't serve it) admit nothing until the pool drains. None =
-        admit nothing this round."""
+        OR because hotter-prefix/fairer requests kept outscoring it —
+        it is the only admissible choice: admit it, or (if the pool
+        still can't serve it) admit nothing until the pool drains.
+        None = admit nothing this round."""
         head = self._queue[0]
         if self._head_skip_id != head.id:
             self._head_skip_id, self._head_skips = head.id, 0
         starved = self._head_skips > self.starvation_rounds
-        best, best_score, head_ok = None, -1, False
-        for i, req in enumerate(
-                itertools.islice(self._queue, self.prefix_window)):
+        # candidate set: each tenant's first queued request; one tenant
+        # present → the first prefix_window requests (the PR-7 window)
+        tenant_heads: Dict[str, Tuple[int, Request]] = {}
+        for i, req in enumerate(self._queue):
+            if req.tenant not in tenant_heads:
+                tenant_heads[req.tenant] = (i, req)
+        wfq = len(tenant_heads) > 1
+        if wfq:
+            candidates = sorted(tenant_heads.values())
+        else:
+            candidates = list(enumerate(
+                itertools.islice(self._queue, self.prefix_window)))
+        best, best_key, head_ok = None, None, False
+        for i, req in candidates:
             ok, score = engine.admit_probe(req.prompt, req.sampling)
             if i == 0:
                 head_ok = ok
@@ -465,8 +738,13 @@ class Scheduler:
                     break        # the head's turn: it or nothing
             if not ok:
                 continue
-            if score > best_score:
-                best, best_score = i, score
+            # min() keys: WFQ ranks by virtual finish first; the
+            # single-tenant key is (-score, i) — most resident blocks,
+            # FCFS ties — the exact pre-tenant ordering
+            key = ((req._wfq_finish, -score, i) if wfq
+                   else (-score, i))
+            if best_key is None or key < best_key:
+                best, best_key = i, key
         if starved:
             best = 0 if head_ok else None
         if best == 0:
@@ -493,6 +771,9 @@ class Scheduler:
                     break          # block pool busy: admit next round
                 req = self._queue[idx]
                 del self._queue[idx]
+                # SFQ virtual time: advance to the admitted request's
+                # start tag so idle tenants re-enter at the live edge
+                self._vtime = max(self._vtime, req._wfq_start)
                 if req.deadline_s is not None:
                     self._queued_deadlines -= 1
                 self._admitting = req
@@ -569,6 +850,86 @@ class Scheduler:
                 self._complete(req)
         return admitted
 
+    # -- preemptible decode (driver side) ---------------------------------
+
+    def _preempt_for_queued(self, epoch: int,
+                            engine: InferenceEngine) -> None:
+        """Park the least urgent running slot when a STRICTLY more
+        urgent request is queued and no slot is free — at most one park
+        per scheduling round (the driver loop converges within a few
+        chunks under a flood; one-at-a-time keeps each round bounded).
+        Chunk-boundary semantics for free: this runs between engine
+        dispatches. The victim keeps its ``Request`` (stream pauses),
+        is bounded by ``max_preemptions`` parks, and its pages stay
+        pinned for the byte-identical resume."""
+        if not engine.paged or engine.free_slots():
+            return
+        victim = None
+        with self._lock:
+            if self._epoch != epoch or not self._queue:
+                return
+            urgent = min(r.priority for r in self._queue)
+            cands = [(slot, req) for slot, req in self._by_slot.items()
+                     if req.priority > urgent
+                     and req.preemptions < self.max_preemptions]
+            if not cands:
+                return
+            # least urgent class first; most remaining work second (the
+            # slot that would hold its pages/slot hostage the longest)
+            slot, victim = max(cands, key=lambda it: (
+                it[1].priority,
+                it[1].sampling.max_new_tokens - len(it[1].tokens)))
+            parked = engine.park(slot)
+            del self._by_slot[slot]
+            victim.preemptions += 1
+            self._parked.append((victim, parked))
+            self.preemptions += 1
+        if self.metrics is not None:
+            self.metrics.request_preempted(
+                victim, queue_depth=self.queue_depth(),
+                active_slots=engine.stats.active_slots)
+
+    def _resume_parked(self, epoch: int,
+                       engine: InferenceEngine) -> None:
+        """Resume parked requests (oldest first) into free slots. A
+        parked request YIELDS to strictly more urgent queued work — the
+        admit pass gets the slot — but only up to ``starvation_rounds``
+        passes, the same anti-starvation contract as the queue head:
+        a batch request always eventually progresses."""
+        resumed: List[Request] = []
+        while True:
+            with self._lock:
+                if (self._epoch != epoch or not self._parked
+                        or not engine.free_slots()):
+                    break
+                req, parked = self._parked[0]
+                if self._parked_skip_id != req.id:
+                    self._parked_skip_id, self._parked_skips = req.id, 0
+                if req.status in (RequestStatus.DONE,
+                                  RequestStatus.FAILED):
+                    # resolved while parked (failover/shutdown race):
+                    # drop the snapshot, never resurrect
+                    self._parked.pop(0)
+                    engine.release_parked(parked)
+                    continue
+                starved = self._parked_skips > self.starvation_rounds
+                urgent_queued = any(r.priority < req.priority
+                                    for r in self._queue)
+                if urgent_queued and not starved:
+                    self._parked_skips += 1
+                    break        # the admit pass takes the free slot
+                slot = engine.resume(parked)
+                self._parked.pop(0)
+                self._parked_skips = 0
+                self._by_slot[slot] = req
+                self.resumes += 1
+                resumed.append(req)
+        for req in resumed:
+            if self.metrics is not None:
+                self.metrics.request_resumed(
+                    req, queue_depth=self.queue_depth(),
+                    active_slots=engine.stats.active_slots)
+
     def step(self) -> int:
         """One scheduling round; returns the number of tokens produced
         (0 = idle). Admission happens BEFORE the decode step so a freed
@@ -584,6 +945,11 @@ class Scheduler:
             epoch = self._epoch
             engine = self.engine
             paused = self._admission_paused
+        if not paused:
+            if self._parked:
+                self._resume_parked(epoch, engine)
+            if self.preempt:
+                self._preempt_for_queued(epoch, engine)
         produced = 0 if paused else self._admit_from_queue(epoch, engine)
         events = engine.step()
         now = time.perf_counter()
@@ -634,6 +1000,29 @@ class Scheduler:
                         f"deadline_s={req.deadline_s:.3g} exceeded "
                         f"mid-generation ({len(req.tokens)} tokens in) — "
                         f"cancelled at chunk boundary")))
+            # the same sweep over PARKED requests: a preempted request
+            # whose caller disconnected or deadline passed must release
+            # its pinned pages and fail typed, never linger parked
+            if self._parked:
+                keep_parked = []
+                for req, parked in self._parked:
+                    dl = req.deadline_t
+                    if req.id in self._cancelled:
+                        self._cancelled.discard(req.id)
+                        engine.release_parked(parked)
+                        failed.append((req, RequestCancelledError(
+                            f"request {req.id} cancelled while parked "
+                            f"({len(req.tokens)} tokens in)")))
+                    elif dl is not None and now > dl:
+                        engine.release_parked(parked)
+                        failed.append((req, DeadlineExceededError(
+                            f"deadline_s={req.deadline_s:.3g} exceeded "
+                            f"while parked ({len(req.tokens)} tokens "
+                            f"in) — preempted and never resumed in "
+                            f"time")))
+                    else:
+                        keep_parked.append((req, parked))
+                self._parked = keep_parked
         for req in completed:
             self._complete(req, now)
         for req, exc in failed:
@@ -690,6 +1079,12 @@ class Scheduler:
             self._epoch += 1
             victims = list(self._by_slot.values())
             self._by_slot.clear()
+            # parked requests die with the engine too: their pinned
+            # pages lived in the DEAD engine's pool — no release needed
+            # (the rebuilt engine starts with a fresh allocator), but
+            # their futures must resolve typed, never silently drop
+            victims.extend(req for req, _parked in self._parked)
+            self._parked.clear()
             if self._admitting is not None:
                 # popped from the queue but wedged inside engine.admit —
                 # in NEITHER collection; its future must not wait for
@@ -745,7 +1140,8 @@ class Scheduler:
                 "server shutting down before this request was scheduled"))
         if finish_running:
             deadline = time.perf_counter() + deadline_s
-            while self._by_slot and time.perf_counter() < deadline:
+            while ((self._by_slot or self._parked)
+                   and time.perf_counter() < deadline):
                 try:
                     self.step()
                 except Exception as e:  # noqa: BLE001 — a broken engine
@@ -762,6 +1158,12 @@ class Scheduler:
             del self._by_slot[slot]
             self._fail(req, SchedulerClosedError(
                 "server shut down mid-generation"))
+        for req, parked in self._parked:
+            self.engine.release_parked(parked)
+            self._fail(req, SchedulerClosedError(
+                "server shut down while this request was parked "
+                "(preempted)"))
+        self._parked = []
         with self._lock:
             admitting = self._admitting
         if admitting is not None:

@@ -211,9 +211,10 @@ def recv_frame(sock: socket.socket) -> Optional[Dict[str, Any]]:
 #: failure happened in-process or across the socket. Import is deferred
 #: so wire.py stays jax-free for the codec unit tests.
 _SCHEDULER_ERRORS = (
-    "AdmissionRejectedError", "QueueFullError", "DeadlineExceededError",
-    "EngineFailedError", "SlotQuarantinedError", "SchedulerClosedError",
-    "RequestCancelledError", "RequestFailedError",
+    "AdmissionRejectedError", "QuotaExceededError", "QueueFullError",
+    "DeadlineExceededError", "EngineFailedError", "SlotQuarantinedError",
+    "SchedulerClosedError", "RequestCancelledError",
+    "RequestFailedError",
 )
 
 
@@ -243,7 +244,10 @@ def frame_to_exception(frame: Dict[str, Any]) -> BaseException:
         from . import scheduler as _sched
         cls = getattr(_sched, name, None)
         if cls is not None:
-            if name == "AdmissionRejectedError":
+            if name in ("AdmissionRejectedError", "QuotaExceededError"):
+                # both take (msg, retry_after_s) — the Retry-After hint
+                # must survive the socket hop so the router's
+                # cheapest-reject ladder and the HTTP 429 stay exact
                 return cls(msg, retry_after_s=float(
                     frame.get("retry_after_s", 1.0)))
             return cls(msg)

@@ -88,6 +88,16 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kv_pages", type=int, default=None)
     p.add_argument("--spec_tokens", type=int, default=0)
     p.add_argument("--max_queue", type=int, default=64)
+    p.add_argument("--quotas-json", default=None, metavar="JSON",
+                   help="per-SLO-class token-rate quotas as JSON, e.g. "
+                        "'{\"batch\": {\"share\": 0.5}}' or "
+                        "'{\"batch\": {\"tokens_per_s\": 200}}'; "
+                        "absent = no quota enforcement (the "
+                        "single-tenant default)")
+    p.add_argument("--preempt", action="store_true",
+                   help="allow parking low-priority decodes at chunk "
+                        "boundaries when strictly more urgent work is "
+                        "queued and no slot is free")
     p.add_argument("--dispatch-timeout", type=float,
                    default=float(os.environ.get(
                        "GYM_TPU_SERVE_WATCHDOG_S", 120.0)))
@@ -105,6 +115,17 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--device", default=None,
                    help="'cpu' pins the CPU backend")
     return p
+
+
+#: Submit-frame fields this worker version understands. Anything else
+#: is IGNORED WITH A NOTE, never rejected: a mixed-version fleet (newer
+#: router teaching frames new fields, older worker) must degrade to
+#: serving the fields it knows — the wire codec already passes unknown
+#: fields through, this pins the worker's side of that contract.
+_SUBMIT_FIELDS = frozenset({
+    "type", "id", "prompt", "sampling", "prefix", "deadline_s",
+    "stream", "submit_timeout", "coalesce_s", "tenant", "slo_class",
+})
 
 
 class WorkerReloadError(RuntimeError):
@@ -144,6 +165,9 @@ class WorkerServer:
             "replica_id": self.replica_id,
             "dead": self.supervisor.failed is not None,
             "backlog_tokens": sched.backlog_tokens(),
+            "backlog_by_class": sched.backlog_tokens_by_class(),
+            "preempt": bool(getattr(sched, "preempt", False)),
+            "tenants": sched.tenant_snapshot(),
             "queue_depth": sched.queue_depth(),
             "active_requests": sched.active_requests(),
             "active_slots": int(stats.active_slots),
@@ -246,6 +270,14 @@ class WorkerServer:
     def _stream_request(self, frame: Dict[str, Any], send, live,
                         cancelled, reg_lock) -> None:
         rid = frame.get("id")
+        unknown = sorted(set(frame) - _SUBMIT_FIELDS)
+        if unknown:
+            # ignored-with-note, never rejected: the router may be a
+            # newer version teaching submit frames new fields
+            sys.stderr.write(
+                f"gym_tpu.serve.worker: submit {rid} carries unknown "
+                f"fields {unknown} — ignoring them (newer router?), "
+                f"serving the fields this worker understands\n")
         try:
             prompt = np.asarray(frame["prompt"], np.int32).reshape(-1)
             sp = wire.sampling_from_dict(frame.get("sampling") or {})
@@ -255,7 +287,9 @@ class WorkerServer:
                 prompt, sp, block=True,
                 timeout=float(frame.get("submit_timeout", 30.0)),
                 deadline_s=(None if deadline_s is None
-                            else float(deadline_s)))
+                            else float(deadline_s)),
+                tenant=frame.get("tenant"),
+                slo_class=frame.get("slo_class"))
         except Exception as e:  # noqa: BLE001 — typed over the wire;
             # the router maps it back to the same class
             with reg_lock:
@@ -473,8 +507,14 @@ def main(argv=None) -> int:
             spec_tokens=args.spec_tokens if paged else 0,
             weights_tag=box.get("tag"))
 
+    quotas = None
+    if args.quotas_json:
+        from .scheduler import ClassQuota
+        quotas = {cls: ClassQuota(**spec)
+                  for cls, spec in json.loads(args.quotas_json).items()}
     sched = Scheduler(factory(), max_queue=args.max_queue,
-                      metrics=metrics)
+                      metrics=metrics, quotas=quotas,
+                      preempt=args.preempt)
     sup = Supervisor(sched, factory,
                      dispatch_timeout_s=args.dispatch_timeout,
                      max_restarts=args.max_restarts, metrics=metrics,

@@ -48,6 +48,51 @@ from .traces import RequestEvent
 
 _EPS = 1e-9
 
+#: mirrors ``serve.scheduler.CLASS_PRIORITY`` (pinned equal by a test);
+#: duplicated here because importing the scheduler would pull jax into
+#: the sweep's fast path. Unclassed requests price as ``standard``.
+_CLASS_PRIORITY = {"interactive": 0, "standard": 1, "batch": 2}
+
+
+class _QuotaBucket:
+    """The modeled twin of ``scheduler._TokenBucket``: a per-class
+    refill bucket over the fleet's MODELED capacity. ``share`` quotas
+    scale with the live healthy-replica count (each live replica runs
+    its own bucket over its own EWMA; the fleet-level model folds them
+    into one bucket at ``share × tokens_per_s × n_healthy``); explicit
+    ``tokens_per_s`` quotas are absolute. Starts full (a cold bucket
+    must not reject the first burst — same as live)."""
+
+    def __init__(self, spec: Any, profile: "ServiceProfile"):
+        spec = (dict(spec) if isinstance(spec, dict)
+                else {"tokens_per_s": float(spec)})
+        self.tokens_per_s = spec.get("tokens_per_s")
+        self.share = spec.get("share")
+        self.burst_s = float(spec.get("burst_s", 2.0))
+        self.profile = profile
+        self.fill: Optional[float] = None
+        self.last = 0.0
+        self.rejected = 0
+
+    def rate(self, n_healthy: int) -> float:
+        if self.tokens_per_s is not None:
+            return float(self.tokens_per_s)
+        return (float(self.share or 0.0) * self.profile.tokens_per_s
+                * max(1, n_healthy))
+
+    def take(self, now: float, n_healthy: int, tokens: float) -> bool:
+        r = self.rate(n_healthy)
+        cap = max(r * self.burst_s, 1.0)
+        if self.fill is None:
+            self.fill = cap
+        self.fill = min(cap, self.fill + max(0.0, now - self.last) * r)
+        self.last = now
+        if tokens <= self.fill + _EPS:
+            self.fill -= tokens
+            return True
+        self.rejected += 1
+        return False
+
 
 @dataclasses.dataclass(frozen=True)
 class ServiceProfile:
@@ -129,7 +174,7 @@ def calibrate_router(router: Any, vocab_size: int, *,
 
 class _Req:
     __slots__ = ("ev", "out", "remaining", "done_tok", "overhead_tok",
-                 "admit_t")
+                 "admit_t", "pri", "seq")
 
     def __init__(self, ev: RequestEvent, out: Outcome,
                  overhead_tok: float):
@@ -141,6 +186,11 @@ class _Req:
         self.remaining = float(ev.max_new) + overhead_tok
         self.done_tok = 0.0
         self.admit_t: Optional[float] = None
+        # class priority + arrival order: with one class every pri is
+        # equal and (pri, seq) admission IS the old FIFO
+        self.pri = _CLASS_PRIORITY.get(
+            getattr(ev, "slo_class", None), 1)
+        self.seq = int(ev.seed)
 
     @property
     def tokens_produced(self) -> float:
@@ -170,10 +220,12 @@ class _Replica:
     lazily to each macro-event time."""
 
     def __init__(self, rid: int, profile: ServiceProfile,
-                 ready_at: float):
+                 ready_at: float, preempt: bool = False):
         self.id = rid
         self.profile = profile
         self.ready_at = ready_at
+        self.preempt = bool(preempt)
+        self.preemptions = 0
         self.retired = False
         self.draining = False
         self.queue: List[_Req] = []
@@ -191,7 +243,8 @@ class _Replica:
         """Committed future work — the same accounting as
         ``Scheduler.backlog_tokens`` (queued max_new + remaining NEW
         tokens of running; the modeled overhead is not a token)."""
-        return (sum(r.ev.max_new for r in self.queue)
+        return (sum(r.ev.max_new - r.tokens_produced
+                    for r in self.queue)
                 + sum(r.ev.max_new - r.tokens_produced
                       for r in self.running))
 
@@ -217,9 +270,35 @@ class _Replica:
         self._sweep_expired(done)
         while (len(self.running) < self.profile.num_slots
                and self.queue):
-            req = self.queue.pop(0)
+            # (pri, seq): weighted-fair order — strict FIFO when every
+            # request shares a class (pri ties break on arrival order)
+            i = min(range(len(self.queue)),
+                    key=lambda j: (self.queue[j].pri,
+                                   self.queue[j].seq))
+            req = self.queue.pop(i)
             req.admit_t = self.t
             self.running.append(req)
+        # preemptible decode (ISSUE 17): park the LOWEST-priority
+        # running request for a strictly-more-urgent queued one. The
+        # parked request keeps its progress (done_tok survives the
+        # round-trip through the queue — the modeled twin of the
+        # engine's park/resume keeping pages pinned) and re-admits by
+        # the same (pri, seq) order.
+        while self.preempt and self.queue and self.running:
+            qi = min(range(len(self.queue)),
+                     key=lambda j: (self.queue[j].pri,
+                                    self.queue[j].seq))
+            vi = max(range(len(self.running)),
+                     key=lambda j: (self.running[j].pri,
+                                    self.running[j].seq))
+            if self.queue[qi].pri >= self.running[vi].pri:
+                break
+            urgent = self.queue.pop(qi)
+            victim = self.running.pop(vi)
+            self.queue.append(victim)
+            urgent.admit_t = self.t
+            self.running.append(urgent)
+            self.preemptions += 1
 
     def advance(self, t_target: float
                 ) -> List[Tuple[_Req, str, float]]:
@@ -294,6 +373,11 @@ class CostModelResult:
     #: same fields the live ``autoscale`` serve.csv rows carry
     autoscale_log: List[Dict[str, Any]]
     max_replicas_seen: int
+    #: multi-tenant counters (ISSUE 17); zero/empty without quotas or
+    #: preemption, so pre-tenant reports are unchanged
+    preemptions: int = 0
+    quota_rejected: Dict[str, int] = dataclasses.field(
+        default_factory=dict)
 
     def report(self, slo_ttft_s: Optional[float] = None,
                wall_s: Optional[float] = None) -> Dict[str, Any]:
@@ -303,7 +387,27 @@ class CostModelResult:
         rep["spawns"] = self.spawns
         rep["retires"] = self.retires
         rep["max_replicas"] = self.max_replicas_seen
+        if self.preemptions or self.quota_rejected:
+            rep["preemptions"] = self.preemptions
+            rep["quota_rejected"] = dict(self.quota_rejected)
         return rep
+
+
+def class_reports(events: List[RequestEvent],
+                  outcomes: List[Outcome],
+                  slo_ttft_s: Optional[float] = None
+                  ) -> Dict[str, Dict[str, Any]]:
+    """Per-SLO-class ``slo_report`` breakdown: outcomes join back to
+    their events on ``index == seed`` (unique per trace), so the
+    tenant sweep reads per-class tails without the Outcome schema
+    growing fields the single-tenant replay arm would have to fake."""
+    cls_of = {int(e.seed): (e.slo_class or "default") for e in events}
+    groups: Dict[str, List[Outcome]] = {}
+    for o in outcomes:
+        groups.setdefault(cls_of.get(o.index, "default"),
+                          []).append(o)
+    return {cls: slo_report(outs, slo_ttft_s=slo_ttft_s)
+            for cls, outs in sorted(groups.items())}
 
 
 class FleetCostModel:
@@ -316,12 +420,19 @@ class FleetCostModel:
     def __init__(self, profile: ServiceProfile,
                  policy: Optional[AutoscalePolicy] = None,
                  initial_replicas: int = 1, autoscale: bool = True,
-                 autoscale_interval_s: float = 1.0):
+                 autoscale_interval_s: float = 1.0,
+                 quotas: Optional[Dict[str, Any]] = None,
+                 preempt: bool = False):
         self.profile = profile
         self.policy = policy or AutoscalePolicy()
         self.autoscale = bool(autoscale)
         self.interval_s = float(autoscale_interval_s)
         self.initial_replicas = int(initial_replicas)
+        #: per-class admission quotas, same spec shape as the live
+        #: ``--quotas`` JSON ({cls: {"share": f}} or
+        #: {cls: {"tokens_per_s": r}}, optional "burst_s")
+        self.quotas = dict(quotas) if quotas else None
+        self.preempt = bool(preempt)
         if self.initial_replicas < 1:
             raise ValueError("initial_replicas must be >= 1")
 
@@ -332,8 +443,12 @@ class FleetCostModel:
         events = sorted(events, key=lambda e: e.arrival_s)
         controller = AutoscaleController(self.policy)
         replicas = [
-            _Replica(i, self.profile, ready_at=0.0)
+            _Replica(i, self.profile, ready_at=0.0,
+                     preempt=self.preempt)
             for i in range(self.initial_replicas)]
+        buckets: Dict[str, _QuotaBucket] = {
+            cls: _QuotaBucket(spec, self.profile)
+            for cls, spec in (self.quotas or {}).items()}
         outcomes: List[Outcome] = []
         live: Dict[int, _Req] = {}
         spawns = retires = 0
@@ -401,7 +516,8 @@ class FleetCostModel:
             advance_all(t)
             if kind == "arrive":
                 arrivals_left -= 1
-                self._arrive(payload, replicas, outcomes, live, t)
+                self._arrive(payload, replicas, outcomes, live, t,
+                             buckets)
             elif kind == "tick" and self.autoscale:
                 decision = self._tick(controller, replicas, t,
                                       auditlog)
@@ -409,7 +525,8 @@ class FleetCostModel:
                     rid = max((r.id for r in replicas), default=-1) + 1
                     replicas.append(_Replica(
                         rid, self.profile,
-                        ready_at=t + self.profile.startup_s))
+                        ready_at=t + self.profile.startup_s,
+                        preempt=self.preempt))
                     spawns += 1
                     max_seen = max(
                         max_seen, sum(1 for r in replicas
@@ -428,17 +545,32 @@ class FleetCostModel:
             outcomes=sorted(outcomes, key=lambda o: o.index),
             replica_seconds=replica_seconds, spawns=spawns,
             retires=retires, autoscale_log=auditlog,
-            max_replicas_seen=max_seen)
+            max_replicas_seen=max_seen,
+            preemptions=sum(r.preemptions for r in replicas),
+            quota_rejected={cls: b.rejected
+                            for cls, b in buckets.items()
+                            if b.rejected})
 
     # -- pieces ------------------------------------------------------------
 
     def _arrive(self, ev: RequestEvent, replicas: List[_Replica],
                 outcomes: List[Outcome], live: Dict[int, _Req],
-                now: float) -> None:
+                now: float,
+                buckets: Optional[Dict[str, _QuotaBucket]] = None
+                ) -> None:
         out = Outcome(index=ev.seed, arrival_s=ev.arrival_s,
                       t_submit=ev.arrival_s, status="failed",
                       max_new=ev.max_new, deadline_s=ev.deadline_s)
         outcomes.append(out)
+        # per-class quota first, like the live scheduler: a class out
+        # of budget is rejected typed BEFORE any replica is consulted
+        bucket = (buckets or {}).get(getattr(ev, "slo_class", None))
+        if bucket is not None:
+            n_healthy = sum(1 for r in replicas if r.healthy(now))
+            if not bucket.take(now, n_healthy, float(ev.max_new)):
+                out.status = "rejected"
+                out.error = "quota"
+                return
         cands = sorted((r for r in replicas if r.healthy(now)),
                        key=lambda r: (r.backlog_tokens(), r.id))
         if not cands:

@@ -35,7 +35,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..serve.autoscale import AutoscalePolicy
 from ..sim import gridlib
-from .cost_model import FleetCostModel, ServiceProfile
+from .cost_model import FleetCostModel, ServiceProfile, class_reports
 from .traces import RequestEvent, make_trace, trace_stats
 
 
@@ -328,6 +328,213 @@ def run_sweep(cfg: ServeSweepConfig) -> List[Dict[str, Any]]:
     return rows
 
 
+# -- multi-tenant sweep: class-mix × quota-policy (ISSUE 17) ---------------
+
+#: the quota-policy axis — name → (quotas builder arg, preempt). The
+#: ``batch_share`` placeholder is resolved per-config so the CLI can
+#: move the knob without redefining the axis.
+TENANT_POLICIES = ("none", "quota", "preempt", "quota+preempt")
+
+
+@dataclasses.dataclass
+class TenantSweepConfig:
+    """Grid axes for the isolation sweep: trace family × class mix ×
+    quota policy, on a FIXED fleet (no autoscaling — the question is
+    what quotas/preemption buy at constant cost, so replica-seconds is
+    held flat and the cost axis becomes forfeited batch goodput)."""
+
+    traces: List[str] = dataclasses.field(
+        default_factory=lambda: ["noisy_neighbor", "mixed_slo"])
+    policies: List[str] = dataclasses.field(
+        default_factory=lambda: list(TENANT_POLICIES))
+    #: the class-mix axis (applies to ``mixed_slo``; ``noisy_neighbor``
+    #: fixes its own two-tenant mix)
+    interactive_fracs: List[float] = dataclasses.field(
+        default_factory=lambda: [0.25, 0.5])
+    batch_share: float = 0.5
+    duration_s: float = 90.0
+    seed: int = 0
+    tokens_per_s: float = 120.0
+    num_slots: int = 4
+    max_queue: int = 64
+    request_overhead_s: float = 0.05
+    replicas: int = 2
+    mixed_total_rps: float = 8.0
+    #: the interactive-class SLO the frontier is judged against
+    slo_ttft_s: float = 2.0
+    slo_attainment_target: float = 0.9
+    out: str = os.path.join("logs", "servesim", "tenant")
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantCell:
+    trace: str
+    policy: str
+    #: None for families whose mix is fixed by the family itself
+    interactive_frac: Optional[float]
+
+    @property
+    def cell_id(self) -> str:
+        mix = ("" if self.interactive_frac is None
+               else f"_mix{self.interactive_frac:g}")
+        return f"{self.trace}{mix}_{self.policy.replace('+', '-')}"
+
+    @property
+    def group_id(self) -> str:
+        """The frontier groups cells that share a workload and differ
+        only in policy."""
+        mix = ("" if self.interactive_frac is None
+               else f" mix={self.interactive_frac:g}")
+        return f"{self.trace}{mix}"
+
+
+def tenant_grid(cfg: TenantSweepConfig) -> List[TenantCell]:
+    cells = []
+    for tr in cfg.traces:
+        mixes = (cfg.interactive_fracs if tr == "mixed_slo"
+                 else [None])
+        for mix in mixes:
+            for pol in cfg.policies:
+                cells.append(TenantCell(tr, pol, mix))
+    return cells
+
+
+def _tenant_trace(cfg: TenantSweepConfig, cell: TenantCell
+                  ) -> List[RequestEvent]:
+    if cell.trace == "mixed_slo":
+        return make_trace(
+            "mixed_slo", seed=cfg.seed, duration_s=cfg.duration_s,
+            total_rps=cfg.mixed_total_rps,
+            interactive_frac=float(cell.interactive_frac or 0.5))
+    return make_trace(cell.trace, seed=cfg.seed,
+                      duration_s=cfg.duration_s)
+
+
+def _policy_args(cfg: TenantSweepConfig, policy: str):
+    quotas = ({"batch": {"share": cfg.batch_share}}
+              if "quota" in policy else None)
+    return quotas, ("preempt" in policy)
+
+
+def run_tenant_cell(cell: TenantCell, cfg: TenantSweepConfig
+                    ) -> Dict[str, Any]:
+    events = _tenant_trace(cfg, cell)
+    quotas, preempt = _policy_args(cfg, cell.policy)
+    profile = ServiceProfile(
+        tokens_per_s=cfg.tokens_per_s, num_slots=cfg.num_slots,
+        max_queue=cfg.max_queue,
+        request_overhead_s=cfg.request_overhead_s)
+    res = FleetCostModel(
+        profile, initial_replicas=cfg.replicas, autoscale=False,
+        quotas=quotas, preempt=preempt).run(events)
+    per = class_reports(events, res.outcomes,
+                        slo_ttft_s=cfg.slo_ttft_s)
+    inter = per.get("interactive", per.get("standard", {}))
+    batch = per.get("batch", {})
+    return {
+        "cell": cell.cell_id,
+        "group": cell.group_id,
+        "trace": cell.trace,
+        "policy": cell.policy,
+        "interactive_frac": cell.interactive_frac,
+        "requests": len(events),
+        "inter_ttft_p50_s": inter.get("ttft_p50_s"),
+        "inter_ttft_p99_s": inter.get("ttft_p99_s"),
+        "inter_slo_attainment": inter.get("slo_attainment"),
+        "inter_shed_rate": inter.get("shed_rate"),
+        "batch_tokens_out": batch.get("tokens_out", 0),
+        "batch_shed_rate": batch.get("shed_rate"),
+        "preemptions": res.preemptions,
+        "quota_rejected": sum(res.quota_rejected.values()),
+        "replica_seconds": round(res.replica_seconds, 1),
+        "by_class": per,
+    }
+
+
+def best_isolation_policy(rows: List[Dict[str, Any]], group: str,
+                          target: float) -> Optional[Dict[str, Any]]:
+    """The headline per workload group: among policies whose
+    INTERACTIVE attainment meets ``target``, the one forfeiting the
+    least batch goodput — isolation at the lowest cost to the
+    neighbor being isolated against."""
+    ok = [r for r in rows if r["group"] == group
+          and (r["inter_slo_attainment"] or 0.0) >= target]
+    return (max(ok, key=lambda r: (r["batch_tokens_out"] or 0,
+                                   r["policy"]))
+            if ok else None)
+
+
+def write_tenant_report(rows: List[Dict[str, Any]],
+                        cfg: TenantSweepConfig) -> str:
+    lines = ["# Multi-tenant isolation sweep "
+             "(class-mix × quota-policy, cost-model fast path)", ""]
+    lines.append(
+        f"Fixed fleet of {cfg.replicas} modeled replicas "
+        f"({cfg.tokens_per_s:g} tok/s over {cfg.num_slots} slots "
+        f"each); interactive SLO: TTFT ≤ {cfg.slo_ttft_s:g} s on ≥ "
+        f"{cfg.slo_attainment_target:.0%} of offered interactive "
+        f"requests. Cost axis: batch tokens forfeited to shedding/"
+        f"quota — replica-seconds is constant by construction.")
+    lines.append("")
+    for grp in sorted({r["group"] for r in rows}):
+        lines.append(f"## {grp}")
+        lines.append("")
+        best = best_isolation_policy(rows, grp,
+                                     cfg.slo_attainment_target)
+        if best is not None:
+            lines.append(
+                f"**Best isolation policy: `{best['policy']}` — "
+                f"interactive p99 TTFT "
+                f"{best['inter_ttft_p99_s']:.3f}s at "
+                f"{best['inter_slo_attainment']:.1%} attainment, "
+                f"{best['batch_tokens_out']} batch tokens kept.**")
+        else:
+            lines.append("**No policy meets the interactive SLO on "
+                         "this workload — the fleet is undersized.**")
+        lines.append("")
+        lines.append("| policy | inter p99 TTFT (s) | inter SLO att. "
+                     "| batch tokens | batch shed | preempts "
+                     "| quota rej |")
+        lines.append("|---|---|---|---|---|---|---|")
+        for r in [r for r in rows if r["group"] == grp]:
+            p99 = r["inter_ttft_p99_s"]
+            lines.append(
+                f"| {r['policy']} "
+                f"| {p99 if p99 is None else f'{p99:.3f}'} "
+                f"| {(r['inter_slo_attainment'] or 0.0):.1%} "
+                f"| {r['batch_tokens_out']} "
+                f"| {(r['batch_shed_rate'] or 0.0):.1%} "
+                f"| {r['preemptions']} | {r['quota_rejected']} |")
+        lines.append("")
+    lines.append("Regression gate: `python -m "
+                 "gym_tpu.servesim.tenant_gate`.")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def run_tenant_sweep(cfg: TenantSweepConfig) -> List[Dict[str, Any]]:
+    sig = json.loads(json.dumps(dataclasses.asdict(cfg)))
+    sig.pop("out", None)
+    gridlib.invalidate_if_stale(cfg.out, sig)
+    cells = tenant_grid(cfg)
+
+    def _run_one(i: int) -> Dict[str, Any]:
+        return run_tenant_cell(cells[i], cfg)
+
+    rows = gridlib.run_cells(cfg.out, [c.cell_id for c in cells],
+                             _run_one)
+    flat = [{k: v for k, v in r.items() if k != "by_class"}
+            for r in rows]
+    gridlib.write_csv(os.path.join(cfg.out, "frontier.csv"), flat)
+    gridlib.atomic_json(os.path.join(cfg.out, "results.json"),
+                        {"config": dataclasses.asdict(cfg),
+                         "rows": rows})
+    with open(os.path.join(cfg.out, "report.md"), "w") as f:
+        f.write(write_tenant_report(rows, cfg))
+    print(f"\nreport: {os.path.join(cfg.out, 'report.md')}")
+    return rows
+
+
 def _floats(s: str) -> List[float]:
     return [float(x) for x in s.split(",") if x.strip()]
 
@@ -341,6 +548,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="Autoscale-policy × replica-bounds × trace-family "
                     "sweep on the cost-model fast path (resumable; "
                     "rerun the same command after a crash)")
+    p.add_argument("--tenant", action="store_true",
+                   help="run the multi-tenant isolation sweep "
+                        "(class-mix × quota-policy on a fixed fleet) "
+                        "instead of the autoscale-policy sweep")
     p.add_argument("--traces", default="diurnal,bursty,flash_crowd")
     p.add_argument("--up-drain", default="2,4")
     p.add_argument("--down-drain", default="0.25,0.5")
@@ -357,6 +568,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--slo-ttft", type=float, default=2.5)
     p.add_argument("--out", default=os.path.join("logs", "servesim"))
     args = p.parse_args(argv)
+
+    if args.tenant:
+        out = args.out
+        if out == os.path.join("logs", "servesim"):
+            out = os.path.join("logs", "servesim", "tenant")
+        # default workload knobs on purpose: the committed artifact
+        # must match what tenant_gate re-prices (its config defaults)
+        run_tenant_sweep(TenantSweepConfig(seed=args.seed, out=out))
+        return 0
 
     bounds = []
     for b in args.bounds.split(","):

@@ -48,7 +48,14 @@ import numpy as np
 TRACE_HEADER = ["arrival_s", "prompt_len", "max_new", "deadline_s",
                 "prefix_group", "seed"]
 
-TRACE_FAMILIES = ("diurnal", "bursty", "flash_crowd")
+#: ISSUE 17: multi-tenant traces append ``tenant``/``slo_class``.
+#: ``save_trace`` only writes this header when some event actually
+#: carries tenant fields (single-tenant traces stay byte-identical to
+#: the v1 format); ``load_trace`` accepts both headers.
+TRACE_HEADER_TENANT = TRACE_HEADER + ["tenant", "slo_class"]
+
+TRACE_FAMILIES = ("diurnal", "bursty", "flash_crowd",
+                  "noisy_neighbor", "tenant_flash", "mixed_slo")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -63,22 +70,31 @@ class RequestEvent:
     prefix_group: Optional[int] = None
     #: per-request sampling seed (determinism across replay arms)
     seed: int = 0
+    #: multi-tenant attribution (ISSUE 17); None = the single-tenant
+    #: default, indistinguishable from a pre-tenant trace
+    tenant: Optional[str] = None
+    slo_class: Optional[str] = None
 
 
 def save_trace(path: str, events: List[RequestEvent]) -> str:
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tenanted = any(e.tenant is not None or e.slo_class is not None
+                   for e in events)
     tmp = path + ".tmp"
     with open(tmp, "w", newline="") as f:
         w = csv.writer(f)
-        w.writerow(TRACE_HEADER)
+        w.writerow(TRACE_HEADER_TENANT if tenanted else TRACE_HEADER)
         for e in events:
             # repr floats: load_trace(save_trace(...)) is EXACT — a
             # trace is an artifact both simulator arms must agree on
-            w.writerow([
+            row = [
                 repr(float(e.arrival_s)), e.prompt_len, e.max_new,
                 "" if e.deadline_s is None else repr(float(e.deadline_s)),
                 "" if e.prefix_group is None else e.prefix_group,
-                e.seed])
+                e.seed]
+            if tenanted:
+                row += [e.tenant or "", e.slo_class or ""]
+            w.writerow(row)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
@@ -89,10 +105,11 @@ def load_trace(path: str) -> List[RequestEvent]:
     with open(path, newline="") as f:
         r = csv.reader(f)
         header = next(r, None)
-        if header != TRACE_HEADER:
+        if header not in (TRACE_HEADER, TRACE_HEADER_TENANT):
             raise ValueError(
                 f"{path} is not a gym_tpu trace (header {header!r}, "
-                f"want {TRACE_HEADER!r})")
+                f"want {TRACE_HEADER!r} or {TRACE_HEADER_TENANT!r})")
+        tenanted = header == TRACE_HEADER_TENANT
         events = []
         for row in r:
             events.append(RequestEvent(
@@ -100,7 +117,9 @@ def load_trace(path: str) -> List[RequestEvent]:
                 max_new=int(row[2]),
                 deadline_s=float(row[3]) if row[3] else None,
                 prefix_group=int(row[4]) if row[4] else None,
-                seed=int(row[5])))
+                seed=int(row[5]),
+                tenant=(row[6] or None) if tenanted else None,
+                slo_class=(row[7] or None) if tenanted else None))
     return events
 
 
@@ -152,11 +171,15 @@ def _shape_events(rng: np.random.Generator, arrivals: List[float], *,
                   deadline_s: Optional[float] = None,
                   deadline_frac: float = 0.0,
                   prefix_groups: int = 0,
-                  prefix_frac_of_requests: float = 0.5
+                  prefix_frac_of_requests: float = 0.5,
+                  tenant: Optional[str] = None,
+                  slo_class: Optional[str] = None
                   ) -> List[RequestEvent]:
     """Attach request shapes to an arrival list. ``deadline_frac`` of
     requests carry ``deadline_s``; ``prefix_frac_of_requests`` of them
-    are spread across ``prefix_groups`` shared-prefix groups."""
+    are spread across ``prefix_groups`` shared-prefix groups;
+    ``tenant``/``slo_class`` stamp every event (multi-tenant families
+    merge several shaped populations via ``_merge_populations``)."""
     events = []
     for i, t in enumerate(arrivals):
         plen = int(rng.integers(prompt_lens[0], prompt_lens[1]))
@@ -169,8 +192,20 @@ def _shape_events(rng: np.random.Generator, arrivals: List[float], *,
                and rng.random() < prefix_frac_of_requests else None)
         events.append(RequestEvent(
             arrival_s=float(t), prompt_len=plen, max_new=mnew,
-            deadline_s=dl, prefix_group=grp, seed=i))
+            deadline_s=dl, prefix_group=grp, seed=i,
+            tenant=tenant, slo_class=slo_class))
     return events
+
+
+def _merge_populations(*pops: List[RequestEvent]) -> List[RequestEvent]:
+    """Interleave per-tenant populations by arrival time and re-seed
+    sequentially so every request's sampling seed is unique across the
+    merged trace (``Outcome.index`` — and the replay arms' per-request
+    determinism — key off the seed)."""
+    merged = sorted((e for pop in pops for e in pop),
+                    key=lambda e: (e.arrival_s, e.tenant or "", e.seed))
+    return [dataclasses.replace(e, seed=i)
+            for i, e in enumerate(merged)]
 
 
 def diurnal_trace(duration_s: float = 60.0, base_rps: float = 2.0,
@@ -243,6 +278,107 @@ def flash_crowd_trace(duration_s: float = 60.0, base_rps: float = 1.0,
     return _shape_events(rng, arr, **shape_kw)
 
 
+# -- multi-tenant families (ISSUE 17) --------------------------------------
+
+
+def noisy_neighbor_trace(duration_s: float = 60.0,
+                         victim_rps: float = 2.0,
+                         flood_rps: float = 12.0,
+                         flood_at_s: float = 15.0,
+                         flood_len_s: float = 30.0,
+                         victim_deadline_s: float = 4.0,
+                         seed: int = 0) -> List[RequestEvent]:
+    """The headline isolation drill as a trace: tenant A runs a steady
+    interactive stream (short prompts, short generations, tight
+    deadlines) while tenant B floods batch work (long generations, no
+    deadline) for ``flood_len_s`` in the middle — the workload a
+    quota/preemption policy must keep A's TTFT flat under."""
+    rng = np.random.default_rng([404, seed])
+    victim = _shape_events(
+        rng, _thinned_poisson(rng, lambda t: victim_rps, duration_s,
+                              victim_rps),
+        prompt_lens=(8, 24), max_news=(4, 12),
+        deadline_s=victim_deadline_s, deadline_frac=1.0,
+        tenant="tenant_a", slo_class="interactive")
+
+    def flood_rate(t):
+        return (flood_rps
+                if flood_at_s <= t < flood_at_s + flood_len_s else 0.0)
+
+    flood = _shape_events(
+        rng, _thinned_poisson(rng, flood_rate, duration_s, flood_rps),
+        prompt_lens=(16, 64), max_news=(24, 64),
+        tenant="tenant_b", slo_class="batch")
+    return _merge_populations(victim, flood)
+
+
+def tenant_flash_trace(duration_s: float = 60.0, tenants: int = 3,
+                       base_rps: float = 1.0, flash_tenant: int = 0,
+                       flash_mult: float = 8.0,
+                       flash_at_s: float = 20.0,
+                       flash_len_s: float = 12.0,
+                       deadline_s: float = 6.0,
+                       seed: int = 0) -> List[RequestEvent]:
+    """Per-tenant flash crowd: ``tenants`` standard-class streams at
+    ``base_rps`` each, one of which (``flash_tenant``) steps to
+    ``flash_mult ×`` for ``flash_len_s`` — does one tenant's surge eat
+    its SIBLINGS' SLO, or only its own quota?"""
+    pops = []
+    for k in range(int(tenants)):
+        rng = np.random.default_rng([505, seed, k])
+        if k == flash_tenant:
+            def rate(t):
+                if flash_at_s <= t < flash_at_s + flash_len_s:
+                    return base_rps * flash_mult
+                return base_rps
+            peak = base_rps * flash_mult
+        else:
+            def rate(t):
+                return base_rps
+            peak = base_rps
+        pops.append(_shape_events(
+            rng, _thinned_poisson(rng, rate, duration_s, peak),
+            prompt_lens=(8, 32), max_news=(8, 24),
+            deadline_s=deadline_s, deadline_frac=1.0,
+            tenant=f"tenant_{k}", slo_class="standard"))
+    return _merge_populations(*pops)
+
+
+def mixed_slo_trace(duration_s: float = 60.0, total_rps: float = 4.0,
+                    interactive_frac: float = 0.5,
+                    batch_frac: float = 0.25,
+                    interactive_deadline_s: float = 4.0,
+                    standard_deadline_s: float = 8.0,
+                    seed: int = 0) -> List[RequestEvent]:
+    """A mixed batch+interactive population from one org: class mix is
+    the knob (``interactive_frac`` + ``batch_frac`` ≤ 1, remainder is
+    ``standard``) — the sweep's class-mix axis. Interactive requests
+    are small and deadline'd, batch requests large and patient."""
+    batch_frac = min(float(batch_frac), 1.0 - float(interactive_frac))
+    rng = np.random.default_rng([606, seed])
+    inter = _shape_events(
+        rng, _thinned_poisson(
+            rng, lambda t: total_rps * interactive_frac, duration_s,
+            total_rps * interactive_frac),
+        prompt_lens=(8, 24), max_news=(4, 12),
+        deadline_s=interactive_deadline_s, deadline_frac=1.0,
+        tenant="org_inter", slo_class="interactive")
+    std_rps = total_rps * max(0.0, 1.0 - interactive_frac - batch_frac)
+    std = _shape_events(
+        rng, _thinned_poisson(rng, lambda t: std_rps, duration_s,
+                              std_rps),
+        prompt_lens=(8, 48), max_news=(8, 32),
+        deadline_s=standard_deadline_s, deadline_frac=1.0,
+        tenant="org_std", slo_class="standard")
+    batch = _shape_events(
+        rng, _thinned_poisson(
+            rng, lambda t: total_rps * batch_frac, duration_s,
+            total_rps * batch_frac),
+        prompt_lens=(16, 64), max_news=(24, 64),
+        tenant="org_batch", slo_class="batch")
+    return _merge_populations(inter, std, batch)
+
+
 def replay_from_serve_csv(path: str, default_max_new: int = 16,
                           deadline_s: Optional[float] = None
                           ) -> List[RequestEvent]:
@@ -285,7 +421,10 @@ def make_trace(family: str, seed: int = 0,
     if family.startswith("replay:"):
         return replay_from_serve_csv(family[len("replay:"):], **kw)
     fns = {"diurnal": diurnal_trace, "bursty": bursty_trace,
-           "flash_crowd": flash_crowd_trace}
+           "flash_crowd": flash_crowd_trace,
+           "noisy_neighbor": noisy_neighbor_trace,
+           "tenant_flash": tenant_flash_trace,
+           "mixed_slo": mixed_slo_trace}
     if family not in fns:
         raise ValueError(f"unknown trace family {family!r}; known: "
                          f"{TRACE_FAMILIES} or replay:<serve.csv>")
@@ -300,7 +439,7 @@ def trace_stats(events: List[RequestEvent]) -> Dict[str, Any]:
     dur = float(arr.max()) if arr.size else 0.0
     bins = np.bincount(arr.astype(int),
                        minlength=int(dur) + 1) if dur else np.array([0])
-    return {
+    stats: Dict[str, Any] = {
         "requests": len(events),
         "duration_s": round(dur, 3),
         "mean_rps": round(len(events) / dur, 3) if dur else None,
@@ -311,6 +450,18 @@ def trace_stats(events: List[RequestEvent]) -> Dict[str, Any]:
         "prefix_grouped": sum(1 for e in events
                               if e.prefix_group is not None),
     }
+    tenants: Dict[str, int] = {}
+    classes: Dict[str, int] = {}
+    for e in events:
+        if e.tenant is not None:
+            tenants[e.tenant] = tenants.get(e.tenant, 0) + 1
+        if e.slo_class is not None:
+            classes[e.slo_class] = classes.get(e.slo_class, 0) + 1
+    if tenants:
+        stats["tenants"] = dict(sorted(tenants.items()))
+    if classes:
+        stats["by_class"] = dict(sorted(classes.items()))
+    return stats
 
 
 def main(argv: Optional[List[str]] = None) -> int:

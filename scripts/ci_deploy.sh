@@ -14,7 +14,12 @@
 #    every hot-swap (per-worker program counters), post-swap streams
 #    byte-exact vs generate_fast;
 # 4. the tracesim bench (`bench.py --tracesim-only`): sim-vs-live
-#    agreement on one trace x policy point, both arms measured.
+#    agreement on one trace x policy point, both arms measured;
+# 5. the TENANT frontier gate (ISSUE 17): the class-mix x quota-policy
+#    grid re-priced on the cost model against the committed baseline
+#    (logs/servesim/tenant/tenant_baseline.json) — every workload group
+#    that met the interactive SLO must still meet it, batch goodput
+#    must not collapse, and isolation ON must not hurt the victim.
 #
 # CPU-only; sized for the 2-core container.
 #
@@ -38,6 +43,13 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu PYTHONPATH="$REPO" \
     python -m gym_tpu.servesim.frontier_gate \
     --baseline logs/servesim/frontier_baseline.json || {
     echo "ci_deploy: serving frontier regression"; exit 1; }
+
+# tenant-isolation frontier gate (ISSUE 17, deterministic cost-model
+# path): per-class SLO attainment + kept batch goodput vs the baseline
+timeout -k 10 300 env JAX_PLATFORMS=cpu PYTHONPATH="$REPO" \
+    python -m gym_tpu.servesim.tenant_gate \
+    --baseline logs/servesim/tenant/tenant_baseline.json || {
+    echo "ci_deploy: tenant-isolation frontier regression"; exit 1; }
 
 # the closed train->deploy loop: trainer (killed + resumed) ->
 # --reload-watch process fleet -> open-loop trace replay; the drill
