@@ -180,7 +180,8 @@ def _supervise() -> int:
                  or "--coldstart-only" in sys.argv
                  or "--tracesim-only" in sys.argv
                  or "--elastic-only" in sys.argv
-                 or "--tenant-only" in sys.argv)
+                 or "--tenant-only" in sys.argv
+                 or "--sdc-only" in sys.argv)
     if not force_cpu:
         probe_cmd = [sys.executable, "-c",
                      "import jax; print('PLATFORM=' + jax.devices()[0].platform)"]
@@ -212,7 +213,8 @@ def _supervise() -> int:
     env["_GYM_TPU_BENCH_CHILD"] = "1"
     if ("--overlap-only" in sys.argv or "--resilience-only" in sys.argv
             or "--sim-only" in sys.argv
-            or "--elastic-only" in sys.argv) and force_cpu:
+            or "--elastic-only" in sys.argv
+            or "--sdc-only" in sys.argv) and force_cpu:
         # ablation-only CPU run: same 16-virtual-device layout the test
         # harness and _overlap_subprocess use (pre-init flag)
         env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=16 "
@@ -465,6 +467,95 @@ def measure_resilience_overhead() -> dict:
         "timing": f"median_of_{windows}_interleaved",
         "watchdog_off_it_s": round(off_its, 3),
         "watchdog_on_it_s": round(on_its, 3),
+        "overhead_pct": round(100.0 * (off_its - on_its) / off_its, 2)
+        if off_its else None,
+        "loss_bit_identical": bit_identical,
+    }
+
+
+def measure_sdc_guard() -> dict:
+    """A/B the ISSUE 20 training guard's steady-state cost: the SAME
+    seeded fit with ``fit(guard=Guard(...))`` (per-drained-step
+    finiteness + worst-node EWMA spike checks, plus the on-device
+    state-fingerprint probe at the checkpoint cadence) vs no guard.
+    The guard is pure observation — the loss trajectories must stay
+    bit-identical — and its host cost is a few float compares per
+    drained step, so the budget is < 2% steps/sec. Both arms
+    ``status=measured``; the checkpoint sidecar writes are active in
+    BOTH arms (always-on, like the fault registry in the resilience
+    ablation) — the guard observation layer is the only toggle."""
+    import shutil
+    import tempfile
+
+    import flax.linen as nn
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from gym_tpu import Trainer
+    from gym_tpu.data import ArrayDataset
+    from gym_tpu.strategy.diloco import DiLoCoStrategy
+    from gym_tpu.strategy.optim import OptimSpec
+    from gym_tpu.utils.compile_cache import enable_compilation_cache
+    from gym_tpu.utils.integrity import Guard
+
+    enable_compilation_cache(
+        os.environ.get("GYM_TPU_BENCH_CACHE_DIR"), min_compile_time_secs=0)
+
+    steps = int(os.environ.get("GYM_TPU_BENCH_SDC_STEPS", 192))
+    spc = int(os.environ.get("GYM_TPU_BENCH_SDC_SPC", 8))
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, batch, train=True):
+            x, y = batch
+            x = x.reshape((x.shape[0], -1))
+            h = nn.relu(nn.Dense(256)(x))
+            logits = nn.Dense(10)(h)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), y).mean()
+
+    rng = np.random.default_rng(0)
+    ds = ArrayDataset(
+        rng.normal(0, 1, size=(8192, 32, 32)).astype(np.float32),
+        rng.integers(0, 10, 8192).astype(np.int32))
+
+    def run(guard_on: bool, max_steps: int):
+        save_dir = tempfile.mkdtemp(prefix="gym_tpu_sdc_ckpt_")
+        try:
+            res = Trainer(MLP(), ds).fit(
+                strategy=DiLoCoStrategy(
+                    optim_spec=OptimSpec("adamw", lr=1e-3), H=100),
+                num_nodes=8, max_steps=max_steps, batch_size=64,
+                minibatch_size=64, steps_per_call=spc, val_size=0,
+                val_interval=0, show_progress=False, seed=7,
+                checkpoint_interval=24, save_dir=save_dir,
+                # fingerprint probe at the checkpoint cadence: the full
+                # defense a production run would arm
+                guard=Guard(fingerprint_interval=24) if guard_on
+                else None,
+                watchdog_timeout=0.0,
+                log_dir=os.environ.get("GYM_TPU_BENCH_LOGDIR",
+                                       "/tmp/gym_tpu_bench_logs"))
+            if res.preempted:
+                raise KeyboardInterrupt("fit preempted mid-benchmark")
+            return res
+        finally:
+            shutil.rmtree(save_dir, ignore_errors=True)
+
+    run(False, 2 * spc)  # primes the persistent compile cache
+    windows = max(1, int(os.environ.get("GYM_TPU_BENCH_SDC_WINDOWS", 5)))
+    off_its, on_its, bit_identical = _interleaved_ab(run, steps, windows)
+    return {
+        "metric": "sdc_guard_overhead_steps_per_sec",
+        "status": "measured",
+        "measured": True,
+        "workload": (f"mlp(1024-256-10), diloco 8n bs64 spc{spc} "
+                     f"x{steps} steps, ckpt every 24, fingerprint "
+                     f"probe every 24"),
+        "timing": f"median_of_{windows}_interleaved",
+        "guard_off_it_s": round(off_its, 3),
+        "guard_on_it_s": round(on_its, 3),
         "overhead_pct": round(100.0 * (off_its - on_its) / off_its, 2)
         if off_its else None,
         "loss_bit_identical": bit_identical,
@@ -1914,7 +2005,8 @@ def main() -> None:
                  or "--coldstart-only" in sys.argv
                  or "--tracesim-only" in sys.argv
                  or "--elastic-only" in sys.argv
-                 or "--tenant-only" in sys.argv)
+                 or "--tenant-only" in sys.argv
+                 or "--sdc-only" in sys.argv)
     if force_cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
 
@@ -1942,6 +2034,10 @@ def main() -> None:
     if "--resilience-only" in sys.argv:
         print(json.dumps(
             {"resilience_overhead": measure_resilience_overhead()}))
+        return
+
+    if "--sdc-only" in sys.argv:
+        print(json.dumps({"sdc_guard": measure_sdc_guard()}))
         return
 
     if "--sim-only" in sys.argv:

@@ -229,7 +229,12 @@ class Program:
         fn = self._fn
         if fn is None:
             fn = self.ensure()
-        return fn(*args)
+        tid = threading.get_ident()
+        _INFLIGHT[tid] = f"{self.name} [{self._key_hash[:12]}]"
+        try:
+            return fn(*args)
+        finally:
+            _INFLIGHT.pop(tid, None)
 
 
 @dataclasses.dataclass
@@ -455,10 +460,20 @@ class ProgramRegistry:
 
         def wrapped(*args):
             if not state["first"]:
-                return fn(*args)
+                tid = threading.get_ident()
+                _INFLIGHT[tid] = name
+                try:
+                    return fn(*args)
+                finally:
+                    _INFLIGHT.pop(tid, None)
             with tracker_lock:
                 if not state["first"]:
-                    return fn(*args)
+                    tid = threading.get_ident()
+                    _INFLIGHT[tid] = name
+                    try:
+                        return fn(*args)
+                    finally:
+                        _INFLIGHT.pop(tid, None)
                 # key from aval TEMPLATES, not the live arrays: the
                 # registry holds the ProgramDef for its lifetime, and
                 # storing the first call's arguments would pin a full
@@ -482,7 +497,12 @@ class ProgramRegistry:
                 with _COMPILE_LOCK:
                     h0, m0 = _disk_events()
                     t0 = time.perf_counter()
-                    out = fn(*args)
+                    tid = threading.get_ident()
+                    _INFLIGHT[tid] = name
+                    try:
+                        out = fn(*args)
+                    finally:
+                        _INFLIGHT.pop(tid, None)
                     dt = time.perf_counter() - t0
                     h1, m1 = _disk_events()
                 with self._lock:
@@ -523,6 +543,21 @@ class ProgramRegistry:
                 return
             del self._store[victim]
             self._evictions += 1
+
+
+# -- in-flight dispatch tracking -------------------------------------------
+
+#: thread ident -> program name for every registry-dispatched program
+#: currently executing. Single dict ops (GIL-atomic) on the hot path —
+#: no lock. Read by the watchdog's stack dump so a wedged dispatch
+#: names the SPECIFIC compiled program, not just "inside jax".
+_INFLIGHT: Dict[int, str] = {}
+
+
+def inflight_programs() -> Dict[int, str]:
+    """Snapshot of registry programs currently executing, keyed by
+    thread ident. Empty when nothing is dispatching."""
+    return dict(_INFLIGHT)
 
 
 # -- module-level default registry ----------------------------------------

@@ -1774,6 +1774,7 @@ class ProcessRouter:
                 "pid": rep.pid,
                 "healthy": rep.healthy,
                 "dead": rep.dead,
+                "death_reason": rep.death_reason,
                 "draining": rep.draining,
                 "retired": rep.retired,
                 "connected": rep.connected,

@@ -449,6 +449,19 @@ def main(argv=None) -> int:
         import jax
         jax.config.update("jax_platforms", "cpu")
 
+    # Per-replica fault arming (SDC drills): GYM_TPU_FAULTS is process-
+    # global, so a spawner env would arm EVERY replica — corrupting the
+    # failover target along with the victim. A replica-suffixed spec
+    # arms just this worker.
+    per_replica = os.environ.get(
+        f"GYM_TPU_FAULTS_REPLICA_{args.replica_id}")
+    if per_replica:
+        from ..utils.resilience import faults
+        faults.configure(per_replica)
+        sys.stderr.write(
+            f"gym_tpu.serve.worker: replica {args.replica_id} armed "
+            f"with faults: {per_replica}\n")
+
     from .. import programs as programs_mod
     if args.program_cache_dir or os.environ.get(
             "GYM_TPU_PROGRAM_CACHE_DIR"):

@@ -14,6 +14,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import signal
+import sys
 import threading
 import time
 from typing import Any, Dict, List, Optional, Union
@@ -29,8 +30,11 @@ from .strategy.base import Strategy, tree_num_params
 from .train_node import (make_eval_step, make_init_fn, make_multi_train_step,
                          make_train_step)
 from .utils.checkpoint import CheckpointManager, CheckpointNotFoundError
+from .utils.integrity import (Guard, GuardRuntime, GuardTrippedError,
+                              _InnerGuard, corrupt_state_tree,
+                              tree_fingerprint)
 from .utils.logger import CSVLogger, Logger, WandbLogger
-from .utils.resilience import Watchdog, fault_point, watch_or_null
+from .utils.resilience import Watchdog, fault_point, faults, watch_or_null
 
 PyTree = Any
 
@@ -154,6 +158,28 @@ class Trainer:
         self.val_dataset = val_dataset
         self.kwargs = kwargs
 
+    @staticmethod
+    def _guard_shutdown(ckpt, logger, wd) -> None:
+        """Release run resources after a guard trip: the checkpoint
+        writer (letting any in-flight PRE-corruption write complete —
+        that is the state the replay resumes from), the log handles
+        (the replay fit reopens them with resume truncation), and the
+        watchdog. No save happens here: corrupt state must never be
+        committed. Best-effort closes — the GuardTrippedError in flight
+        is the error that matters."""
+        if ckpt is not None:
+            try:
+                ckpt.close()
+            except Exception:
+                pass
+        try:
+            logger.log_event("training guard tripped: rolling back")
+            logger.close()
+        except Exception:
+            pass
+        if wd is not None:
+            wd.close()
+
     def fit(
         self,
         num_epochs: int = 1,
@@ -191,8 +217,52 @@ class Trainer:
         run_name: Optional[str] = None,
         log_dir: str = "logs",
         show_progress: bool = True,
+        guard: Optional[Any] = None,
         **extra,
     ) -> FitResult:
+        # Captured BEFORE any parameter is normalized: the rollback-and-
+        # replay wrapper below re-invokes fit with these exact arguments.
+        _fit_kwargs = {k: v for k, v in locals().items()
+                       if k not in ("self", "extra", "guard")}
+        # SDC guard (ISSUE 20): guard=Guard(...)/True/GuardRuntime runs
+        # the whole fit under an anomaly monitor with automatic
+        # rollback-and-replay. This OUTER wrapper owns the replay loop;
+        # the recursive call carries an _InnerGuard marker so the inner
+        # fit only observes (and the monitor state survives attempts).
+        # Because the loop is bit-deterministic and CSVLogger resume
+        # truncates rows >= the restored step, a replayed train.csv is
+        # byte-identical to an uninterrupted run — the recovery oracle.
+        if guard is not None and guard is not False \
+                and not isinstance(guard, _InnerGuard):
+            if isinstance(guard, GuardRuntime):
+                _rt = guard
+            elif isinstance(guard, Guard):
+                _rt = GuardRuntime(guard)
+            elif guard is True:
+                _rt = GuardRuntime()
+            else:
+                raise ValueError(
+                    f"guard must be a Guard, GuardRuntime, or True; "
+                    f"got {guard!r}")
+            while True:
+                try:
+                    return self.fit(guard=_InnerGuard(_rt), **_fit_kwargs)
+                except GuardTrippedError as e:
+                    if _rt.rollbacks >= _rt.cfg.max_rollbacks:
+                        raise
+                    _rt.note_rollback()
+                    sys.stderr.write(
+                        f"gym_tpu: {e} — rolling back to the last "
+                        f"verified checkpoint and replaying (attempt "
+                        f"{_rt.rollbacks}/{_rt.cfg.max_rollbacks})\n")
+                    sys.stderr.flush()
+                    # replay resumes from the newest CHECKSUM-VERIFIED
+                    # checkpoint (restore quarantines past corrupt
+                    # steps); with no checkpointing configured this
+                    # degrades to a full from-scratch replay
+                    _fit_kwargs["resume"] = "auto"
+        guard_rt: Optional[GuardRuntime] = (
+            guard.runtime if isinstance(guard, _InnerGuard) else None)
         if strategy is None:
             raise ValueError("fit requires a strategy")
         if extra:
@@ -799,6 +869,14 @@ class Trainer:
             corr_jit = jax.jit(_corr_moments,
                                out_shardings=runtime.replicated_sharding)
 
+        guard_fp_jit = None
+        if guard_rt is not None and guard_rt.cfg.fingerprint_interval:
+            # one folded-sum scalar over the whole train state — the
+            # guard's drift probe for corruption a healthy-looking loss
+            # can hide (strategy state only read at the next outer sync)
+            guard_fp_jit = jax.jit(tree_fingerprint,
+                                   out_shardings=runtime.replicated_sharding)
+
         # Deferred host fetches (host-overlap discipline): eval and
         # correlation DISPATCH immediately but their device→host fetch is
         # queued and drained only after the next train dispatch is in
@@ -873,6 +951,10 @@ class Trainer:
             if replicate is not None:
                 m = replicate(m)
             loss_a = np.asarray(m["loss"])[0].reshape(count)
+            # worst loss across nodes: the guard's trip channel. np.max
+            # propagates NaN, so a single non-finite replica is seen too
+            worst_a = (np.asarray(m["loss"]).max(axis=0).reshape(count)
+                       if guard_rt is not None else None)
             # loss is deliberately node 0's (the reference logs rank 0's,
             # train_node.py:175-176); comm is the per-node MEAN — under
             # partial participation it varies per node (dead nodes report
@@ -903,6 +985,12 @@ class Trainer:
                 step_j = first_idx + j
                 loss = float(loss_a[j])
                 comm = float(comm_a[j])
+                # observe BEFORE the row is logged: a tripped step's
+                # corrupt loss must never land in train.csv (the replay
+                # byte-identity oracle compares against a clean run)
+                if guard_rt is not None:
+                    guard_rt.observe_loss(step_j, loss,
+                                          worst=float(worst_a[j]))
                 last_loss = loss
                 sim_j = (net_sim.step_time(step_j, comp_est)
                          if net_sim is not None else None)
@@ -1046,6 +1134,11 @@ class Trainer:
         try:
             for s in sched:
                 fault_point("dispatch.boundary")
+                if faults.active:
+                    # the dispatch.state corruption site: an armed
+                    # bitflip flips exponent bits in the live state —
+                    # the SDC the guard (not any crc) must catch
+                    state = corrupt_state_tree(state)
                 if profile_dir and not profile_done:
                     if profiling and step_idx >= profile_stop:
                         jax.profiler.stop_trace()
@@ -1097,6 +1190,17 @@ class Trainer:
                         steady_from = step_idx
                 drain_host()
                 pending = (step_idx, metrics, s)
+                if guard_fp_jit is not None and _due(
+                        guard_rt.cfg.fingerprint_interval, step_idx, s):
+                    # dispatch the probe now, defer the host fetch past
+                    # the next dispatch (same overlap as eval/correlation)
+                    fp_dev = guard_fp_jit(state)
+
+                    def _check_fp(fp=fp_dev, st=step_idx + s):
+                        guard_rt.observe_fingerprint(
+                            st, float(np.asarray(fp)))
+
+                    pending_host.append(_check_fp)
                 for _ in range(s):
                     logger.increment_step()
                 prev_idx, step_idx = step_idx, step_idx + s
@@ -1117,6 +1221,14 @@ class Trainer:
                             f"watchdog timeout in '{wd.fired}' — aborting")
                     preempted = True
                     break
+        except GuardTrippedError:
+            # the anomaly monitor fired: close everything WITHOUT saving
+            # — corrupt state must never be committed (save_checkpoint
+            # drains pending metrics BEFORE saving, so a trip always
+            # aborts ahead of the write) — and release the log handles
+            # so the outer wrapper's replay fit can reopen them cleanly
+            self._guard_shutdown(ckpt, logger, wd)
+            raise
         except BaseException:
             # shut the checkpoint writer down without masking the original
             # error; the prefetch worker is closed in the finally below
@@ -1137,11 +1249,16 @@ class Trainer:
                 except (ValueError, OSError):
                     pass
 
-        if pending is not None:
-            with watch_or_null(wd, "dispatch.drain"):
-                drain(pending)
-            pending = None
-        drain_host()
+        try:
+            if pending is not None:
+                with watch_or_null(wd, "dispatch.drain"):
+                    drain(pending)
+                pending = None
+            drain_host()
+        except GuardTrippedError:
+            # the final drain can still observe a corrupt step
+            self._guard_shutdown(ckpt, logger, wd)
+            raise
         if profiling:
             jax.profiler.stop_trace()
         if preempted:

@@ -1,10 +1,16 @@
 from .checkpoint import CheckpointNotFoundError
 from .compile_cache import enable_compilation_cache
+from .integrity import (ChecksumMismatchError, Guard, GuardRuntime,
+                        GuardTrippedError, crc32c, tree_fingerprint,
+                        verify_sidecar, write_sidecar)
 from .logger import CSVLogger, Logger, WandbLogger
-from .resilience import (FAULT_SITES, RetryPolicy, Watchdog, fault_point,
-                         faults, with_retries)
+from .resilience import (FAULT_SITES, RetryPolicy, Watchdog, corrupt_point,
+                         fault_point, faults, with_retries)
 
 __all__ = ["CSVLogger", "Logger", "WandbLogger",
            "CheckpointNotFoundError", "FAULT_SITES", "RetryPolicy",
            "Watchdog", "fault_point", "faults", "with_retries",
-           "enable_compilation_cache"]
+           "enable_compilation_cache",
+           "ChecksumMismatchError", "Guard", "GuardRuntime",
+           "GuardTrippedError", "crc32c", "tree_fingerprint",
+           "verify_sidecar", "write_sidecar", "corrupt_point"]

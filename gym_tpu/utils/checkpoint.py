@@ -51,6 +51,7 @@ from typing import Any, Optional, Tuple
 import jax
 import numpy as np
 
+from . import integrity
 from .resilience import (RetryPolicy, Watchdog, dump_thread_stacks,
                          fault_point, watch_or_null, with_retries)
 
@@ -121,6 +122,11 @@ def restore_params(run_dir: str, step: Optional[int] = None,
                 f"no checkpoint to restore under {path}")
 
         def read(s):
+            # checksum gate first — a bit-flipped shard must surface as
+            # the typed mismatch (and a skip to an older step), never as
+            # silently wrong serving params; missing sidecar = old
+            # checkpoint, accepted
+            integrity.verify_sidecar(os.path.join(path, str(s)))
             restored = mgr.restore(
                 s, args=ocp.args.Composite(
                     state=ocp.args.StandardRestore(),
@@ -231,6 +237,21 @@ class CheckpointManager:
         with watch_or_null(self._watchdog, f"checkpoint.write step {step}"):
             with_retries(attempt, self._retry,
                          describe=f"checkpoint write (step {step})")
+        # SDC defense (ISSUE 20): hash the finalized step dir into its
+        # integrity sidecar, THEN pass it through the checkpoint.bytes
+        # corruption site — so the sidecar records the GOOD bytes and an
+        # injected bitflip is caught at restore, exactly like real bit
+        # rot between write and read. Primary process only: the Orbax
+        # write is collective, the sidecar is one file on shared storage.
+        if jax.process_index() == 0:
+            step_dir = os.path.join(self.directory, str(step))
+            with_retries(
+                lambda: integrity.write_sidecar(
+                    step_dir,
+                    fingerprint=integrity.tree_fingerprint_host(state)),
+                self._retry,
+                describe=f"integrity sidecar write (step {step})")
+            integrity.corrupt_checkpoint_files(step_dir)
 
     def save(self, step: int, state: PyTree, data_state: dict,
              extra: Optional[dict] = None) -> None:
@@ -311,6 +332,12 @@ class CheckpointManager:
     def _restore_step(self, step: int, template_state: PyTree
                       ) -> Tuple[int, PyTree, dict, dict]:
         ocp = self._ocp
+        # Content verification BEFORE Orbax parses anything: a bit flip
+        # Orbax would happily deserialize raises the typed
+        # ChecksumMismatchError here, which the newest-first fallback
+        # quarantines like any other corrupt step. Sidecar-less steps
+        # (pre-integrity checkpoints) pass unverified — soft-degrade.
+        integrity.verify_sidecar(os.path.join(self.directory, str(step)))
         # template=None → Orbax's template-free read: the tree comes back
         # exactly as saved (host arrays). The elastic resume path uses
         # this — the saved (K, layout) need not match the live state.
@@ -436,12 +463,22 @@ class CheckpointManager:
         src = os.path.join(self.directory, str(step))
         for k in range(100):
             dst = f"{src}.corrupt-{k}"
-            if not os.path.exists(dst):
-                try:
-                    os.rename(src, dst)
-                    return
-                except OSError:
-                    break
+            if os.path.exists(dst):
+                continue
+            try:
+                os.rename(src, dst)
+                return
+            except OSError as e:
+                # A racing quarantine (or a leftover file at dst) can
+                # land between the exists() probe and the rename — that
+                # is a COLLISION, so try the next suffix; anything else
+                # (src vanished, permissions) won't be fixed by a
+                # different k, fall through to the rmtree.
+                import errno
+                if e.errno in (errno.EEXIST, errno.ENOTEMPTY,
+                               errno.ENOTDIR, errno.EISDIR):
+                    continue
+                break
         shutil.rmtree(src, ignore_errors=True)  # last resort: unblock
 
     def purge(self) -> None:

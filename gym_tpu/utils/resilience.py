@@ -33,6 +33,12 @@ Registered fault sites (each lists who fires it):
 ``serve.decode``        ``InferenceEngine.step``, before the decode dispatch
 ``serve.admit``         ``Scheduler.submit``, before admission control
 ``serve.http``          ``gym_tpu.serve`` HTTP handler, top of ``POST``
+``checkpoint.bytes``    ``integrity.corrupt_checkpoint_files``, after every
+                        finalized checkpoint save (corruption-only site)
+``wire.frame``          ``serve/wire.py:encode_frame``, every outgoing
+                        frame's encoded bytes (corruption-only site)
+``dispatch.state``      ``integrity.corrupt_state_tree``, top of every
+                        dispatch iteration (corruption-only site)
 ====================== ====================================================
 
 ``GYM_TPU_FAULTS`` spec: comma-separated ``site:action[=arg][@window]``
@@ -40,9 +46,15 @@ where action is one of ``kill`` (SIGKILL self — simulated preemption
 without grace), ``sigterm`` (SIGTERM self — preemption WITH grace, the
 Trainer's handler takes an emergency checkpoint), ``oserror`` (raise
 ``OSError``), ``delay`` (sleep ``arg`` seconds), ``hang`` (sleep
-``arg or 3600`` seconds — watchdog bait); and window is ``@N`` (Nth hit
-only, 1-based), ``@N-M`` (hits N..M), or ``@N+`` (every hit from N).
-Default window: every hit. Example::
+``arg or 3600`` seconds — watchdog bait), ``bitflip=<n>`` (flip ``n``
+deterministically-random bits of the site's payload — silent data
+corruption), or ``truncate[=n]`` (drop the payload's last ``n`` bytes,
+default half — a torn write); and window is ``@N`` (Nth hit only,
+1-based), ``@N-M`` (hits N..M), or ``@N+`` (every hit from N). Default
+window: every hit. ``bitflip``/``truncate`` only take effect at the
+corruption-capable sites, which pass their payload through
+``faults.corrupt``; at plain ``fault_point`` sites they count the hit
+and do nothing. Example::
 
     GYM_TPU_FAULTS="checkpoint.write:oserror@1-2,dispatch.boundary:kill@5"
 """
@@ -57,6 +69,7 @@ import sys
 import threading
 import time
 import traceback
+import zlib
 from contextlib import contextmanager, nullcontext
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -69,9 +82,18 @@ FAULT_SITES = (
     "serve.decode",
     "serve.admit",
     "serve.http",
+    "checkpoint.bytes",
+    "wire.frame",
+    "dispatch.state",
 )
 
-_ACTIONS = ("kill", "sigterm", "oserror", "delay", "hang")
+_ACTIONS = ("kill", "sigterm", "oserror", "delay", "hang",
+            "bitflip", "truncate")
+
+#: Actions that transform a payload instead of performing a side effect.
+#: They fire only through ``FaultRegistry.corrupt`` / ``fire_matched`` —
+#: a plain ``fault_point`` has no bytes to corrupt.
+_CORRUPT_ACTIONS = ("bitflip", "truncate")
 
 
 class InjectedFault(OSError):
@@ -158,14 +180,62 @@ class FaultRegistry:
         """Count a hit at ``site`` and perform any matching rule's action.
         Called via ``fault_point`` — a no-op (one attribute read) when no
         rules are armed."""
+        self.fire_matched(site)
+
+    def fire_matched(self, site: str) -> Tuple[int, List[_Rule]]:
+        """Count a hit, PERFORM matching side-effect rules (kill, delay,
+        ...) and return ``(hit, corruption_rules)`` — the hook for sites
+        whose payload isn't plain bytes (``dispatch.state`` applies the
+        returned ``bitflip`` rules to a live device tree itself)."""
         with self._lock:
             n = self._hits.get(site, 0) + 1
             self._hits[site] = n
             matched = [r for r in self._rules
                        if r.site == site and r.first <= n
                        and (r.last is None or n <= r.last)]
+        corrupt_rules = []
         for r in matched:
-            self._perform(r, site, n)
+            if r.action in _CORRUPT_ACTIONS:
+                corrupt_rules.append(r)
+            else:
+                self._perform(r, site, n)
+        return n, corrupt_rules
+
+    def corrupt(self, site: str, data: bytes) -> bytes:
+        """Count a hit at ``site`` and pass ``data`` through any matching
+        ``bitflip``/``truncate`` rules (side-effect rules still perform).
+        Deterministic: corrupted positions are seeded from site + hit
+        number, so a campaign seed reproduces the exact same wrong
+        bytes. Returns ``data`` unchanged when nothing matches."""
+        n, rules = self.fire_matched(site)
+        out = data
+        for r in rules:
+            if out:
+                out = self._corrupt_payload(out, r, site, n)
+        return out
+
+    @staticmethod
+    def _corrupt_payload(data: bytes, rule: _Rule, site: str,
+                         hit: int) -> bytes:
+        tag = f"injected fault at {site} (hit {hit})"
+        rng = random.Random(zlib.crc32(f"{site}:{hit}".encode()))
+        if rule.action == "bitflip":
+            buf = bytearray(data)
+            nbits = max(1, int(rule.arg))
+            for _ in range(nbits):
+                buf[rng.randrange(len(buf))] ^= 1 << rng.randrange(8)
+            sys.stderr.write(
+                f"{tag}: bitflip {nbits} bit(s) in {len(buf)} bytes\n")
+            sys.stderr.flush()
+            return bytes(buf)
+        if rule.action == "truncate":
+            drop = int(rule.arg) or max(1, len(data) // 2)
+            drop = min(drop, len(data))
+            sys.stderr.write(
+                f"{tag}: truncate last {drop} of {len(data)} bytes\n")
+            sys.stderr.flush()
+            return data[:len(data) - drop]
+        return data
 
     @staticmethod
     def _perform(rule: _Rule, site: str, hit: int) -> None:
@@ -199,6 +269,16 @@ def fault_point(site: str) -> None:
     are armed; otherwise counts the hit and performs matching actions."""
     if faults.active:
         faults.fire(site)
+
+
+def corrupt_point(site: str, data: bytes) -> bytes:
+    """Payload-carrying twin of ``fault_point``: pass ``data`` through
+    any armed corruption rules at ``site``. Returns ``data`` unchanged
+    (no hit counted) when no faults are armed at all — the hot-path
+    cost stays one attribute read, same contract as ``fault_point``."""
+    if faults.active:
+        return faults.corrupt(site, data)
+    return data
 
 
 # -- retry policy ---------------------------------------------------------
@@ -274,8 +354,22 @@ def with_retries(fn: Callable, policy: RetryPolicy, *,
 
 def dump_thread_stacks(header: str) -> str:
     """Every live thread's current stack, formatted — the payload a hung
-    run leaves behind instead of an eternal silent stall."""
+    run leaves behind instead of an eternal silent stall. When the
+    program registry reports compiled programs in flight, their keys
+    lead the dump, so a wedged dispatch is attributable to a SPECIFIC
+    compiled program, not just 'the main thread is inside jax'."""
     lines = [header]
+    try:
+        # Deferred + guarded: the registry pulls jax; the watchdog must
+        # dump stacks even in a process where jax never imported.
+        from ..programs.registry import inflight_programs
+        inflight = inflight_programs()
+    except Exception:
+        inflight = {}
+    if inflight:
+        lines.append("in-flight registry programs (thread id -> key):")
+        for tid, key in sorted(inflight.items()):
+            lines.append(f"  thread {tid}: program {key}")
     frames = sys._current_frames()
     for t in threading.enumerate():
         lines.append(f"\n--- thread {t.name} (daemon={t.daemon}) ---")

@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Run every CI gate in sequence with a per-gate pass/fail + wall-time
+# summary (ISSUE 20 satellite). Exits nonzero at the FIRST failing gate
+# — later gates are reported as skipped so the summary still prints.
+#
+# Order: tier1 first (the broad net), then the per-subsystem gates
+# roughly by how much earlier-gate machinery they lean on.
+#
+# Usage: scripts/ci_all.sh   (from the repo root or anywhere)
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+GATES=(tier1 faults sim serve chaos analyze deploy elastic sdc)
+
+declare -A RESULT TIME
+failed=""
+for g in "${GATES[@]}"; do
+    if [ -n "$failed" ]; then
+        RESULT[$g]="skipped"
+        TIME[$g]="-"
+        continue
+    fi
+    echo "==== gate: $g ===================================================="
+    t0=$SECONDS
+    "scripts/ci_${g}.sh"
+    rc=$?
+    TIME[$g]=$((SECONDS - t0))
+    if [ $rc -eq 0 ]; then
+        RESULT[$g]="pass"
+    else
+        RESULT[$g]="FAIL (rc=$rc)"
+        failed=$g
+    fi
+done
+
+echo
+echo "==== gate summary ================================================="
+for g in "${GATES[@]}"; do
+    printf '  %-8s %-12s %ss\n' "$g" "${RESULT[$g]}" "${TIME[$g]}"
+done
+if [ -n "$failed" ]; then
+    echo "FIRST FAILING GATE: $failed"
+    exit 1
+fi
+echo "ALL ${#GATES[@]} GATES GREEN"

@@ -627,12 +627,19 @@ assert swap["post_swap_params_verified"] is True, head
 # streamed p99 TTFB tracking p99 TTFT (not completion time)
 ab = head["process_ab"]
 assert ab["status"] == "measured" and ab["measured"] is True, ab
-# small noise margin on the 2-core box (the measured headline runs
+# small noise margin on a >=2-core box (the measured headline runs
 # 1.2-1.6x; a CI pass within noise of parity is not a regression —
-# the structural asserts inside bench.py still gate the protocol)
-assert ab["process_fleet_tok_s"] >= 0.95 * ab["thread_fleet_tok_s"], (
+# the structural asserts inside bench.py still gate the protocol).
+# On a SINGLE core the premise of the A/B is gone: router + 2 worker
+# subprocesses time-slice one CPU, so process >= thread is
+# unsatisfiable by construction (unmodified HEAD measures ~0.90x
+# there) — keep only an IPC-overhead sanity floor.
+import os
+floor = 0.95 if (os.cpu_count() or 1) >= 2 else 0.70
+assert ab["process_fleet_tok_s"] >= floor * ab["thread_fleet_tok_s"], (
     f"2-subprocess fleet {ab['process_fleet_tok_s']} tok/s well under "
-    f"the thread fleet {ab['thread_fleet_tok_s']} tok/s")
+    f"the thread fleet {ab['thread_fleet_tok_s']} tok/s "
+    f"(floor {floor}, cores {os.cpu_count()})")
 assert ab["p99_ttfb_s"] <= ab["p99_ttft_s"] * 1.5 + 0.2, ab
 assert ab["p99_ttfb_s"] < ab["p99_completion_s"], ab
 assert all(c == 0 for c in ab["worker_programs_compiled"]), (

@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Silent-data-corruption gate (ISSUE 20) — the ninth gate, run NEXT TO
+# scripts/ci_tier1.sh and the others. End-to-end integrity defense:
+#
+#   1. the integrity unit suite (tests/test_integrity.py): crc32c,
+#      checkpoint sidecars written-at-save / verified-at-restore with
+#      quarantine through `.corrupt-k` (collisions included), per-frame
+#      wire crc with typed FrameCorruptError, the corruption fault
+#      actions (bitflip/truncate) at checkpoint.bytes / wire.frame /
+#      dispatch.state, and the watchdog's in-flight program attribution;
+#   2. the training guard (tests/test_guard_rollback.py): anomaly
+#      detection (non-finite / EWMA spike on the worst-node loss /
+#      state-fingerprint drift) and rollback-and-replay whose oracle is
+#      a train.csv BYTE-IDENTICAL to an uninterrupted run;
+#   3. seeded chaos campaigns (tests/test_chaos_campaign.py): >= 5
+#      seeds of random fault mixes over every compatible train-pipeline
+#      site driven through the subprocess kill-harness worker — no
+#      silent divergence, every failure typed, recovery completes;
+#   4. wire-corruption failover (tests/test_sdc_wire_failover.py): a
+#      replica emitting bit-flipped frames dies TYPED and the stream
+#      completes byte-exact through the sibling — never a wrong token.
+#
+# CPU-only, sized for the 2-core container (suite runs in ~2 min warm;
+# the timeout leaves headroom for cold compile caches).
+#
+# Usage: scripts/ci_sdc.sh   (from the repo root or anywhere)
+set -o pipefail
+cd "$(dirname "$0")/.."
+rm -f /tmp/_sdc.log
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_integrity.py tests/test_guard_rollback.py \
+    tests/test_chaos_campaign.py tests/test_sdc_wire_failover.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_sdc.log
+rc=${PIPESTATUS[0]}
+echo SDC_DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' \
+    /tmp/_sdc.log | tr -cd . | wc -c)
+exit $rc

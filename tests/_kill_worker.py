@@ -39,6 +39,11 @@ def main() -> None:
                          "ZeroReduce AdamW with sharded (ZeRO-2) "
                          "checkpoints — the elastic drill workload "
                          "(ISSUE 16)")
+    ap.add_argument("--guard", action="store_true",
+                    help="run under fit(guard=...): the SDC anomaly "
+                         "monitor with rollback-and-replay — required "
+                         "when the armed faults include dispatch.state "
+                         "corruption, which no crc can catch")
     ap.add_argument("--result", default="")
     args = ap.parse_args()
 
@@ -85,6 +90,11 @@ def main() -> None:
     else:
         strategy = SimpleReduceStrategy(OptimSpec("sgd", lr=0.05))
 
+    guard = None
+    if args.guard:
+        from gym_tpu.utils.integrity import Guard
+        guard = Guard(max_rollbacks=3)
+
     res = Trainer(Tiny(), ArrayDataset(x, labels)).fit(
         strategy=strategy,
         num_nodes=args.num_nodes, max_steps=args.max_steps, batch_size=16,
@@ -93,6 +103,7 @@ def main() -> None:
         run_name="kill", log_dir=args.log_dir,
         async_checkpoint=not args.sync_ckpt,
         prefetch=not args.no_prefetch,
+        guard=guard,
     )
     if args.result:
         with open(args.result, "w") as f:

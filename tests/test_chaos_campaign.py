@@ -1,0 +1,269 @@
+"""Seeded chaos campaigns (ISSUE 20): random fault mixes over every
+compatible train-pipeline site, with the three campaign invariants —
+no silent divergence, every failure typed, recovery completes.
+
+Fast tests pin the campaign machinery itself (schedule determinism,
+spec grammar round-trip, exit classification, the launch/relaunch loop)
+against stubs. The slow test is the real thing: >=5 seeded campaigns
+over the subprocess kill-harness worker (``tests/_kill_worker.py`` with
+``--sync-ckpt --guard``), each verified by byte-comparing the completed
+``train.csv`` against a fault-free oracle and restoring params from the
+surviving run directory. ``scripts/ci_sdc.sh`` runs the slow test."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from gym_tpu.utils import chaos
+from gym_tpu.utils.chaos import (ChaosEvent, CampaignResult,
+                                 GUARD_SAFE_FIRST_HIT,
+                                 TRAIN_SITE_ACTIONS, WATCHDOG_EXIT_CODE,
+                                 classify_exit, faults_spec,
+                                 run_train_campaign, sample_schedule)
+from gym_tpu.utils.resilience import FaultRegistry, Watchdog, faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "_kill_worker.py")
+MAX_STEPS = 12
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# -- schedule sampling ------------------------------------------------------
+
+
+def test_sample_schedule_deterministic_and_well_formed():
+    for seed in range(20):
+        a = sample_schedule(seed)
+        b = sample_schedule(seed)
+        assert a == b, f"seed {seed} not reproducible"
+        assert 1 <= len(a) <= 3
+        for ev in a:
+            assert ev.action in TRAIN_SITE_ACTIONS[ev.site]
+            assert ev.last == ev.first  # single-hit by construction
+            assert ev.first >= 1
+            if ev.site == "dispatch.state":
+                # corruption inside the guard's warmup is undetectable
+                # by construction — the sampler must never schedule it
+                assert ev.first >= GUARD_SAFE_FIRST_HIT
+            if ev.action == "delay":
+                assert 0.01 <= ev.arg <= 0.1
+            if ev.action == "bitflip":
+                assert 1 <= ev.arg <= 4
+    # seeds actually vary the schedule
+    assert len({faults_spec(sample_schedule(s)) for s in range(20)}) > 5
+
+
+def test_sampled_specs_parse_into_fault_registry():
+    # the whole point of spec(): every sampled schedule must be a valid
+    # GYM_TPU_FAULTS string the real registry accepts
+    for seed in range(30):
+        spec = faults_spec(sample_schedule(seed))
+        reg = FaultRegistry()
+        reg.configure(spec)
+        assert len(reg._rules) == len(sample_schedule(seed))
+
+
+def test_event_spec_grammar():
+    assert ChaosEvent("dispatch.boundary", "kill",
+                      first=3, last=3).spec() == "dispatch.boundary:kill@3"
+    assert ChaosEvent("prefetch.fill", "delay", arg=0.05, first=2,
+                      last=2).spec() == "prefetch.fill:delay=0.05@2"
+    assert ChaosEvent("dispatch.state", "bitflip", arg=2.0, first=5,
+                      last=5).spec() == "dispatch.state:bitflip=2@5"
+    assert ChaosEvent("wire.frame", "bitflip", arg=1.0,
+                      first=4).spec() == "wire.frame:bitflip=1@4+"
+    assert ChaosEvent("checkpoint.write", "oserror", first=2,
+                      last=4).spec() == "checkpoint.write:oserror@2-4"
+    two = [ChaosEvent("dispatch.boundary", "kill", first=3, last=3),
+           ChaosEvent("checkpoint.bytes", "truncate", first=1, last=1)]
+    assert faults_spec(two) == ("dispatch.boundary:kill@3,"
+                                "checkpoint.bytes:truncate@1")
+
+
+# -- exit classification ----------------------------------------------------
+
+
+def test_classify_exit():
+    assert classify_exit(0) == "clean"
+    assert classify_exit(-9) == "killed"
+    assert classify_exit(137) == "killed"
+    assert classify_exit(-15) == "sigterm"
+    assert classify_exit(143) == "sigterm"
+    assert classify_exit(WATCHDOG_EXIT_CODE) == "watchdog"
+    assert classify_exit(1, "Traceback ...\nChecksumMismatchError: x") \
+        == "typed:ChecksumMismatchError"
+    assert classify_exit(1, "GuardTrippedError: loss spike") \
+        == "typed:GuardTrippedError"
+    assert classify_exit(1, "SomeRandomError: boom") == "unclassified"
+    assert classify_exit(1, "") == "unclassified"
+
+
+def test_watchdog_exit_code_pinned_to_resilience():
+    # chaos duplicates the literal to stay importable without jax; this
+    # is the tripwire if resilience ever renumbers
+    assert WATCHDOG_EXIT_CODE == Watchdog.EXIT_CODE
+
+
+# -- campaign loop against stub launches ------------------------------------
+
+
+def test_campaign_first_launch_armed_rest_fault_free():
+    seen = []
+
+    def launch(spec):
+        seen.append(spec)
+        if len(seen) == 1:
+            return {"returncode": -9, "stderr": "", "completed": False}
+        return {"returncode": 0, "stderr": "", "completed": True}
+
+    res = run_train_campaign(7, launch)
+    assert res.ok
+    assert res.attempts == ["killed", "clean"]
+    assert seen[0] == faults_spec(sample_schedule(7))
+    assert seen[1] == ""
+
+
+def test_campaign_untyped_death_is_violation():
+    def launch(spec):
+        return {"returncode": 1, "stderr": "KeyError: 'oops'",
+                "completed": False}
+
+    res = run_train_campaign(1, launch, max_launches=4)
+    assert not res.ok
+    assert res.attempts == ["unclassified"]  # stops at the first escape
+    assert any("UNTYPED" in v for v in res.violations)
+
+
+def test_campaign_typed_deaths_retry_until_budget():
+    def launch(spec):
+        return {"returncode": 1,
+                "stderr": "gym_tpu.utils.resilience.InjectedFault: x",
+                "completed": False}
+
+    res = run_train_campaign(2, launch, max_launches=3)
+    assert not res.completed
+    assert res.attempts == ["typed:InjectedFault"] * 3
+    assert any("did not complete" in v for v in res.violations)
+
+
+def test_campaign_verify_violations_and_exceptions_surface():
+    ok_launch = lambda spec: {"returncode": 0, "stderr": "",
+                              "completed": True}
+    res = run_train_campaign(3, ok_launch,
+                             verify=lambda: ["csv diverged"])
+    assert res.completed and not res.ok
+    assert res.violations == ["csv diverged"]
+
+    def bad_verify():
+        raise OSError("cannot read train.csv")
+
+    res = run_train_campaign(3, ok_launch, verify=bad_verify)
+    assert any("verify() raised OSError" in v for v in res.violations)
+
+
+def test_campaign_launch_exception_is_violation_not_crash():
+    def launch(spec):
+        raise RuntimeError("harness bug")
+
+    res = run_train_campaign(4, launch)
+    assert not res.ok
+    assert any("launch 0 raised RuntimeError" in v
+               for v in res.violations)
+
+
+# -- the real thing: seeded campaigns over the subprocess worker ------------
+
+
+def _run_worker(save_dir, log_dir, *, spec="", result=None, timeout=240):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["GYM_TPU_FAULTS"] = spec
+    env["GYM_TPU_IO_RETRIES"] = "2"
+    env["GYM_TPU_IO_RETRY_BASE_S"] = "0.01"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [sys.executable, WORKER, "--save-dir", str(save_dir),
+           "--log-dir", str(log_dir), "--max-steps", str(MAX_STEPS),
+           "--ckpt-interval", "3", "--sync-ckpt", "--guard"]
+    if result:
+        cmd += ["--result", str(result)]
+    return subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                          text=True, timeout=timeout)
+
+
+def _train_csv_bytes(log_dir):
+    with open(os.path.join(str(log_dir), "kill", "train.csv"), "rb") as f:
+        return f.read()
+
+
+@pytest.fixture(scope="session")
+def campaign_scratch(tmp_path_factory):
+    return tmp_path_factory.mktemp("chaos")
+
+
+@pytest.fixture(scope="session")
+def campaign_baseline(campaign_scratch):
+    """Fault-free oracle run (same worker flags the campaigns use);
+    also warms the shared compile cache for every campaign launch."""
+    os.environ.setdefault("GYM_TPU_TEST_COMPILE_CACHE",
+                          str(campaign_scratch / "xla_cache"))
+    result = campaign_scratch / "base.json"
+    p = _run_worker(campaign_scratch / "base_ckpt",
+                    campaign_scratch / "base_logs", result=result)
+    assert p.returncode == 0, p.stderr[-4000:]
+    assert json.loads(open(result).read())["steps"] == MAX_STEPS
+    return _train_csv_bytes(campaign_scratch / "base_logs")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [11, 12, 13, 14, 15])
+def test_seeded_campaign_holds_invariants(campaign_scratch,
+                                          campaign_baseline, seed):
+    from gym_tpu.utils.checkpoint import restore_params
+
+    base = campaign_scratch / f"seed{seed}"
+    save, log = base / "ckpt", base / "logs"
+    result = base / "result.json"
+
+    def launch(spec):
+        if os.path.exists(result):
+            os.unlink(result)
+        p = _run_worker(save, log, spec=spec, result=result)
+        completed = False
+        if p.returncode == 0 and os.path.exists(result):
+            out = json.loads(open(result).read())
+            completed = (out["steps"] == MAX_STEPS
+                         and not out["preempted"])
+        return {"returncode": p.returncode, "stderr": p.stderr,
+                "completed": completed}
+
+    def verify():
+        violations = []
+        got = _train_csv_bytes(log)
+        if got != campaign_baseline:
+            violations.append(
+                f"seed {seed}: train.csv diverged from fault-free "
+                f"oracle ({len(got)} vs {len(campaign_baseline)} bytes)")
+        step, params, _extra = restore_params(str(save / "kill"))
+        if not params or step <= 0:
+            violations.append(
+                f"seed {seed}: restore_params failed on surviving run "
+                f"dir (step={step})")
+        return violations
+
+    res = run_train_campaign(seed, launch, verify=verify)
+    assert res.ok, (
+        f"campaign seed {seed} violated invariants:\n"
+        f"  schedule: {faults_spec(res.events)}\n"
+        f"  attempts: {res.attempts}\n"
+        f"  violations: {res.violations}")

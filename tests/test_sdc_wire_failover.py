@@ -1,0 +1,82 @@
+"""Wire-corruption failover (ISSUE 20): a replica whose frames arrive
+with flipped bits must NEVER deliver a wrong token — the per-frame crc
+turns the corruption into a typed ``FrameCorruptError``, the router
+marks the replica dead with that reason, and the stream completes
+byte-exact through the surviving sibling.
+
+Fault arming is PER-REPLICA via ``GYM_TPU_FAULTS_REPLICA_<id>`` (a
+fleet-wide ``GYM_TPU_FAULTS`` would corrupt the failover target too).
+The window ``@4+`` leaves the hello (hit 1) and the first health_ok
+frames clean so ``wait_ready`` can see a healthy fleet before the
+corruption strikes every later frame replica 0 sends.
+
+Own module (not ``test_serve_procfleet``): the shared fleet fixture
+there must stay corruption-free, and the env var has to be set BEFORE
+the fleet spawns. Slow: two worker subprocesses each pay a jax import.
+``scripts/ci_sdc.sh`` runs this file."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import jax
+
+from gym_tpu.models.nanogpt import GPT, GPTConfig, generate_fast
+from gym_tpu.serve.engine import SamplingParams
+from gym_tpu.serve.metrics import ServeMetrics
+from gym_tpu.serve.router import build_process_fleet
+
+pytestmark = pytest.mark.slow
+
+_ARM_VAR = "GYM_TPU_FAULTS_REPLICA_0"
+
+
+@pytest.fixture()
+def corrupt_fleet():
+    os.environ[_ARM_VAR] = "wire.frame:bitflip=1@4+"
+    cfg = GPTConfig(block_size=64, vocab_size=48, n_layer=2, n_head=2,
+                    n_embd=32, dropout=0.0, bias=True)
+    model = GPT(cfg)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        np.zeros((1, 8), np.int64),
+                        train=False)["params"]
+    metrics = ServeMetrics(tempfile.mkdtemp(prefix="gym_tpu_sdcm_"))
+    router = build_process_fleet(
+        params, cfg, tempfile.mkdtemp(prefix="gym_tpu_sdcw_"),
+        replicas=2, num_slots=2, metrics=metrics, no_warmup=True,
+        max_restarts=0, log=lambda *a, **k: None)
+    try:
+        router.start()
+        router.wait_ready(timeout_s=240)
+        yield cfg, params, router
+    finally:
+        os.environ.pop(_ARM_VAR, None)
+        router.close(drain_deadline_s=60)
+        metrics.close()
+
+
+def test_corrupt_wire_frames_fail_over_without_wrong_tokens(
+        corrupt_fleet):
+    cfg, params, router = corrupt_fleet
+    prompt = [1, 2, 3, 4, 5, 6]
+    ref = generate_fast(params, cfg, np.asarray(prompt)[None], 16,
+                        temperature=0.9, top_k=7,
+                        seed=3)[0, len(prompt):].tolist()
+    got = []
+    pr = router.submit(prompt, SamplingParams(
+        max_new_tokens=16, temperature=0.9, top_k=7, seed=3))
+    for chunk in pr.stream(timeout=120):
+        got.extend(chunk)
+    # never a wrong token: the stream is byte-exact despite replica 0
+    # emitting corrupt frames for every post-readiness message
+    assert got == ref, (got, ref)
+
+    st = router.status()
+    dead = [r for r in st["replicas"] if r.get("dead")]
+    assert dead, st
+    assert any("FrameCorruptError" in (r.get("death_reason") or "")
+               for r in dead), st
+    # the survivor is still healthy — the fleet did not collapse
+    assert any(not r.get("dead") for r in st["replicas"]), st
